@@ -1,0 +1,13 @@
+"""mmlspark_tpu: a TPU-native framework with the capabilities of MMLSpark.
+
+Estimator/Transformer pipelines over distributed Tables; numeric engines are
+JAX/XLA/Pallas with ICI collectives (see SURVEY.md at the repo root for the
+reference blueprint this was built against).
+"""
+__version__ = "0.1.0"
+
+from .core import (Table, Pipeline, PipelineModel, Estimator, Transformer,
+                   Model, Params, Param)
+
+__all__ = ["Table", "Pipeline", "PipelineModel", "Estimator", "Transformer",
+           "Model", "Params", "Param", "__version__"]

@@ -1,0 +1,16 @@
+from .params import (Param, Params, HasInputCol, HasOutputCol, HasInputCols,
+                     HasLabelCol, HasFeaturesCol, HasWeightCol, HasPredictionCol,
+                     HasScoredLabelsCol, HasScoresCol, HasProbabilitiesCol, HasSeed,
+                     in_range, one_of, positive)
+from .table import Table
+from .pipeline import (PipelineStage, Transformer, Model, Estimator, Evaluator,
+                       Pipeline, PipelineModel, ml_transform, ml_fit, STAGE_REGISTRY)
+
+__all__ = [
+    "Param", "Params", "Table", "PipelineStage", "Transformer", "Model",
+    "Estimator", "Evaluator", "Pipeline", "PipelineModel", "ml_transform",
+    "ml_fit", "STAGE_REGISTRY", "HasInputCol", "HasOutputCol", "HasInputCols",
+    "HasLabelCol", "HasFeaturesCol", "HasWeightCol", "HasPredictionCol",
+    "HasScoredLabelsCol", "HasScoresCol", "HasProbabilitiesCol", "HasSeed",
+    "in_range", "one_of", "positive",
+]

@@ -1,0 +1,211 @@
+"""Param system: typed, JSON-serializable hyperparameters shared by every pipeline stage.
+
+TPU-native re-design of the reference's SparkML param contracts
+(reference: src/main/scala/com/microsoft/ml/spark/core/contracts/Params.scala:9-60 and the
+~25 injected Param[T] subclasses under src/main/scala/org/apache/spark/ml/param/).
+
+Instead of JVM Param objects wired through py4j, params here are plain Python descriptors
+collected per-class at definition time. Complex values (arrays, nested stages, callables)
+are handled by pluggable codecs in `mmlspark_tpu.core.serialize`.
+"""
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Optional
+
+
+class Param:
+    """A single named, documented hyperparameter with optional validation.
+
+    Mirrors the role of SparkML's ``Param[T]`` (reference:
+    org/apache/spark/ml/param/*.scala) without the JVM: a descriptor on the
+    stage class. Serialization of complex values (arrays, nested stages) is
+    dispatched on runtime type in `mmlspark_tpu.core.serialize`.
+    """
+
+    __slots__ = ("name", "doc", "default", "validator", "owner")
+
+    def __init__(self, name: str, doc: str = "", default: Any = None,
+                 validator: Optional[Callable[[Any], bool]] = None):
+        self.name = name
+        self.doc = doc
+        self.default = default
+        self.validator = validator
+        self.owner = None  # set by Params.__init_subclass__
+
+    def validate(self, value: Any) -> None:
+        if self.validator is not None and value is not None:
+            if not self.validator(value):
+                raise ValueError(
+                    f"Param {self.name}={value!r} failed validation")
+
+    def __repr__(self):
+        return f"Param({self.name!r}, default={self.default!r})"
+
+    # descriptor protocol: stage.num_leaves reads the current value
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj.get_or_default(self.name)
+
+    def __set__(self, obj, value):
+        obj.set(**{self.name: value})
+
+
+# ---------------------------------------------------------------------------
+# common validators
+
+def in_range(lo=None, hi=None):
+    def check(v):
+        if lo is not None and v < lo:
+            return False
+        if hi is not None and v > hi:
+            return False
+        return True
+    return check
+
+
+def one_of(*options):
+    return lambda v: v in options
+
+
+positive = in_range(lo=0)
+
+
+class Params:
+    """Base for anything carrying Params. Collects Param descriptors across the MRO.
+
+    Equivalent in role to SparkML's ``Params`` trait plus the reference's
+    ``ComplexParamsWritable`` (org/apache/spark/ml/Serializer.scala:21-70):
+    every stage's state is exactly its uid + its param map, so save/load and
+    copy are generic.
+    """
+
+    _param_registry: dict  # class-level: name -> Param
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        registry = {}
+        for klass in reversed(cls.__mro__):
+            for key, val in vars(klass).items():
+                if isinstance(val, Param):
+                    val.owner = val.owner or klass.__name__
+                    registry[val.name] = val
+        cls._param_registry = registry
+
+    def __init__(self, **kwargs):
+        self._paramMap: dict[str, Any] = {}
+        self.uid = f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
+        self.set(**kwargs)
+
+    # -- access ------------------------------------------------------------
+    @classmethod
+    def params(cls) -> dict[str, Param]:
+        return dict(cls._param_registry)
+
+    def has_param(self, name: str) -> bool:
+        return name in self._param_registry
+
+    def is_set(self, name: str) -> bool:
+        return name in self._paramMap
+
+    def get(self, name: str) -> Any:
+        if name not in self._param_registry:
+            raise KeyError(f"{type(self).__name__} has no param {name!r}")
+        return self._paramMap.get(name)
+
+    def get_or_default(self, name: str) -> Any:
+        if name in self._paramMap:
+            return self._paramMap[name]
+        if name not in self._param_registry:
+            raise KeyError(f"{type(self).__name__} has no param {name!r}")
+        return self._param_registry[name].default
+
+    def set(self, **kwargs) -> "Params":
+        for name, value in kwargs.items():
+            if name not in self._param_registry:
+                raise KeyError(
+                    f"{type(self).__name__} has no param {name!r}; "
+                    f"known: {sorted(self._param_registry)}")
+            self._param_registry[name].validate(value)
+            self._paramMap[name] = value
+        return self
+
+    def clear(self, name: str) -> "Params":
+        self._paramMap.pop(name, None)
+        return self
+
+    def copy(self, extra: Optional[dict] = None) -> "Params":
+        other = type(self).__new__(type(self))
+        other.__dict__.update(
+            {k: v for k, v in self.__dict__.items() if k != "_paramMap"})
+        other._paramMap = dict(self._paramMap)
+        if extra:
+            other.set(**extra)
+        return other
+
+    def explain_params(self) -> str:
+        lines = []
+        for name, p in sorted(self._param_registry.items()):
+            cur = self._paramMap.get(name, p.default)
+            lines.append(f"{name}: {p.doc} (default: {p.default!r}, current: {cur!r})")
+        return "\n".join(lines)
+
+    def param_map(self) -> dict[str, Any]:
+        """Effective values: explicit settings over defaults."""
+        out = {n: p.default for n, p in self._param_registry.items()}
+        out.update(self._paramMap)
+        return out
+
+    def __repr__(self):
+        explicit = ", ".join(f"{k}={v!r}" for k, v in sorted(self._paramMap.items()))
+        return f"{type(self).__name__}({explicit})"
+
+
+# ---------------------------------------------------------------------------
+# Shared column-role param mixins (reference: core/contracts/Params.scala:9-66)
+
+class HasInputCol(Params):
+    input_col = Param("input_col", "name of the input column", "input")
+
+
+class HasOutputCol(Params):
+    output_col = Param("output_col", "name of the output column", "output")
+
+
+class HasInputCols(Params):
+    input_cols = Param("input_cols", "names of the input columns", None)
+
+
+class HasLabelCol(Params):
+    label_col = Param("label_col", "name of the label column", "label")
+
+
+class HasFeaturesCol(Params):
+    features_col = Param("features_col", "name of the features column", "features")
+
+
+class HasWeightCol(Params):
+    weight_col = Param("weight_col", "name of the sample-weight column", None)
+
+
+class HasPredictionCol(Params):
+    prediction_col = Param("prediction_col", "name of the prediction column", "prediction")
+
+
+class HasScoredLabelsCol(Params):
+    scored_labels_col = Param(
+        "scored_labels_col", "column holding predicted labels", "scored_labels")
+
+
+class HasScoresCol(Params):
+    scores_col = Param("scores_col", "column holding raw prediction scores", "scores")
+
+
+class HasProbabilitiesCol(Params):
+    probabilities_col = Param(
+        "probabilities_col", "column holding class probabilities", "probabilities")
+
+
+class HasSeed(Params):
+    seed = Param("seed", "random seed (threaded through jax.random keys)", 0)

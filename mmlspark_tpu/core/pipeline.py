@@ -1,0 +1,163 @@
+"""Estimator/Transformer/Pipeline contracts over Table.
+
+Role-equivalent to SparkML's Pipeline abstraction the reference composes everything
+through (SURVEY.md overview; reference README.md:19-31), re-designed Python-first:
+- Transformer.transform(Table) -> Table
+- Estimator.fit(Table) -> Model (a fitted Transformer)
+- Pipeline chains stages; PipelineModel chains fitted stages.
+
+Save/load is generic over the param map plus a per-stage state dict of arrays
+(the reference needs ~250 LoC of injected ComplexParamsSerializer for this —
+org/apache/spark/ml/Serializer.scala:21-70; here it falls out of the design).
+
+Telemetry: every public fit/transform logs a JSON usage event, mirroring
+logging/BasicLogging.scala:30-92.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import List, Optional, Sequence
+
+from .params import Param, Params
+from .table import Table
+
+_logger = logging.getLogger("mmlspark_tpu.usage")
+
+# class-name -> class, for generic load(); populated by PipelineStage.__init_subclass__
+STAGE_REGISTRY: dict = {}
+
+
+def _log_event(stage, method: str):
+    # reference: logging/BasicLogging.scala:30-34 emits {uid, className, method}
+    _logger.info(json.dumps({
+        "uid": getattr(stage, "uid", None),
+        "className": type(stage).__name__,
+        "method": method,
+        "ts": time.time(),
+    }))
+
+
+class PipelineStage(Params):
+    """Base of every stage; registers subclasses for generic save/load."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        # qualified key is authoritative (save_stage records it); the bare
+        # name is a convenience fallback and may be shadowed by a same-named
+        # class from another module.
+        STAGE_REGISTRY[f"{cls.__module__}.{cls.__name__}"] = cls
+        STAGE_REGISTRY[cls.__name__] = cls
+
+    # -- persistence hooks --------------------------------------------------
+    def _get_state(self) -> dict:
+        """Extra fitted state: dict of name -> ndarray | bytes | json-able.
+        Override in Models."""
+        return {}
+
+    def _set_state(self, state: dict) -> None:
+        pass
+
+    def save(self, path: str) -> None:
+        from . import serialize
+        serialize.save_stage(self, path)
+
+    @classmethod
+    def load(cls, path: str):
+        from . import serialize
+        return serialize.load_stage(path)
+
+
+class Transformer(PipelineStage):
+    def transform(self, table: Table) -> Table:
+        _log_event(self, "transform")
+        return self._transform(table)
+
+    def _transform(self, table: Table) -> Table:
+        raise NotImplementedError
+
+    def __call__(self, table: Table) -> Table:
+        return self.transform(table)
+
+
+class Model(Transformer):
+    """A fitted Transformer (may reference its parent estimator params)."""
+
+
+class Estimator(PipelineStage):
+    def fit(self, table: Table, **fit_params) -> Model:
+        _log_event(self, "fit")
+        if fit_params:
+            return self.copy(fit_params)._fit(table)
+        return self._fit(table)
+
+    def _fit(self, table: Table) -> Model:
+        raise NotImplementedError
+
+
+class Evaluator(Params):
+    """Scores a transformed Table; higher-is-better unless is_larger_better False."""
+
+    def evaluate(self, table: Table) -> float:
+        raise NotImplementedError
+
+    @property
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class Pipeline(Estimator):
+    stages = Param("stages", "ordered list of pipeline stages", None)
+
+    def __init__(self, stages: Optional[Sequence[PipelineStage]] = None, **kwargs):
+        super().__init__(**kwargs)
+        if stages is not None:
+            self.set(stages=list(stages))
+
+    def _fit(self, table: Table) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        current = table
+        stages = self.get_or_default("stages") or []
+        # transforms past the last Estimator feed nothing — skip them
+        last_est = max((i for i, s in enumerate(stages)
+                        if isinstance(s, Estimator)), default=-1)
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+                fitted.append(model)
+                if i < last_est:
+                    current = model.transform(current)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < last_est:
+                    current = stage.transform(current)
+            else:
+                raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Model):
+    stages = Param("stages", "ordered list of fitted transformers", None)
+
+    def __init__(self, stages: Optional[Sequence[Transformer]] = None, **kwargs):
+        super().__init__(**kwargs)
+        if stages is not None:
+            self.set(stages=list(stages))
+
+    def _transform(self, table: Table) -> Table:
+        current = table
+        for stage in self.get_or_default("stages") or []:
+            current = stage.transform(current)
+        return current
+
+
+# Fluent API (reference: core/spark/FluentAPI.scala:10-28)
+def ml_transform(table: Table, *transformers: Transformer) -> Table:
+    for t in transformers:
+        table = t.transform(table)
+    return table
+
+
+def ml_fit(table: Table, estimator: Estimator) -> Model:
+    return estimator.fit(table)

@@ -1,0 +1,157 @@
+"""Generic stage persistence: params to JSON, arrays to npz, nested stages to subdirs.
+
+Replaces the reference's injected ComplexParamsSerializer machinery
+(org/apache/spark/ml/Serializer.scala, ComplexParamsSerializer.scala ~250 LoC) —
+standard SparkML cannot persist stages whose params are models/DataFrames/byte
+arrays, so the reference patches Spark internals. Here complex values are handled
+by kind-tagged codecs.
+
+Layout on disk:
+    <path>/metadata.json      {class, uid, params:{name:{kind,value|ref}}, state_keys}
+    <path>/arrays.npz         ndarray params + ndarray state
+    <path>/state.json         json-able state
+    <path>/stages/<i>_<name>/ nested stage params (recursively)
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+
+def _is_stage(v) -> bool:
+    from .pipeline import PipelineStage
+    return isinstance(v, PipelineStage)
+
+
+def save_stage(stage, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    meta: dict[str, Any] = {
+        "class": f"{type(stage).__module__}.{type(stage).__name__}",
+        "uid": stage.uid,
+        "params": {},
+        "format_version": 1,
+    }
+    arrays: dict[str, np.ndarray] = {}
+
+    for name, value in stage._paramMap.items():
+        if value is None:
+            meta["params"][name] = {"kind": "json", "value": None}
+        elif _is_stage(value):
+            sub = os.path.join(path, "stages", f"p_{name}")
+            save_stage(value, sub)
+            meta["params"][name] = {"kind": "stage", "ref": f"stages/p_{name}"}
+        elif isinstance(value, (list, tuple)) and value and all(_is_stage(v) for v in value):
+            refs = []
+            for i, v in enumerate(value):
+                sub = os.path.join(path, "stages", f"{name}_{i}")
+                save_stage(v, sub)
+                refs.append(f"stages/{name}_{i}")
+            meta["params"][name] = {"kind": "stage_list", "refs": refs}
+        elif isinstance(value, np.ndarray):
+            if value.dtype == object:
+                # np.savez would pickle these and load (allow_pickle=False)
+                # would then fail — encode as a JSON list instead.
+                meta["params"][name] = {"kind": "object_array",
+                                        "value": value.tolist()}
+            else:
+                arrays[f"param__{name}"] = value
+                meta["params"][name] = {"kind": "array", "ref": f"param__{name}"}
+        else:
+            try:
+                json.dumps(value)
+                meta["params"][name] = {"kind": "json", "value": value}
+            except TypeError:
+                raise TypeError(
+                    f"param {name!r} of {type(stage).__name__} holds "
+                    f"non-serializable value {type(value).__name__}; "
+                    f"mark it transient or provide an array/stage value")
+
+    state = stage._get_state()
+    json_state, state_keys = {}, []
+    for key, value in state.items():
+        state_keys.append(key)
+        if isinstance(value, np.ndarray):
+            if value.dtype == object:
+                json_state[key] = value.tolist()
+            else:
+                arrays[f"state__{key}"] = value
+        else:
+            # jax arrays land here too
+            try:
+                import jax
+                if isinstance(value, jax.Array):
+                    arrays[f"state__{key}"] = np.asarray(value)
+                    continue
+            except ImportError:
+                pass
+            json_state[key] = value
+    meta["state_keys"] = state_keys
+
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    if arrays:
+        np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    if json_state:
+        with open(os.path.join(path, "state.json"), "w") as f:
+            json.dump(json_state, f)
+
+
+def load_stage(path: str):
+    from .pipeline import STAGE_REGISTRY
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    cls = STAGE_REGISTRY.get(meta["class"])
+    if cls is None:  # fall back to bare name (older saves / moved modules)
+        cls = STAGE_REGISTRY.get(meta["class"].rsplit(".", 1)[-1])
+    if cls is None:
+        raise KeyError(f"unknown stage class {meta['class']!r}; import its module first")
+
+    arrays = {}
+    npz_path = os.path.join(path, "arrays.npz")
+    if os.path.exists(npz_path):
+        with np.load(npz_path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+
+    params = {}
+    for name, spec in meta["params"].items():
+        kind = spec["kind"]
+        if kind == "json":
+            params[name] = spec["value"]
+        elif kind == "object_array":
+            params[name] = np.asarray(spec["value"], dtype=object)
+        elif kind == "array":
+            params[name] = arrays[spec["ref"]]
+        elif kind == "stage":
+            params[name] = load_stage(os.path.join(path, spec["ref"]))
+        elif kind == "stage_list":
+            params[name] = [load_stage(os.path.join(path, r)) for r in spec["refs"]]
+        else:
+            raise ValueError(f"unknown param kind {kind!r}")
+
+    stage = cls.__new__(cls)
+    stage._paramMap = {}
+    stage.uid = meta["uid"]
+    # re-run any non-param init state with defaults, then apply params
+    try:
+        cls.__init__(stage)
+    except TypeError:
+        pass
+    stage._paramMap = {}
+    stage.uid = meta["uid"]
+    stage.set(**{k: v for k, v in params.items()})
+
+    state = {}
+    json_path = os.path.join(path, "state.json")
+    if os.path.exists(json_path):
+        with open(json_path) as f:
+            state.update(json.load(f))
+    for key in meta.get("state_keys", []):
+        ref = f"state__{key}"
+        if ref in arrays:
+            state[key] = arrays[ref]
+    if state:
+        stage._set_state(state)
+    return stage

@@ -1,0 +1,177 @@
+"""Table: the distributed-DataFrame stand-in every stage consumes and produces.
+
+The reference operates on Spark DataFrames partitioned across executors; distributed
+behavior is driven by *partition count* (SURVEY.md §4: partition-as-node). Here the
+substrate is a columnar Table — an ordered dict of host numpy columns (row-major first
+axis) plus a partition count. Partitions map 1:1 onto TPU devices when a stage executes
+on a mesh (`mmlspark_tpu.parallel`): partition-as-device replaces partition-as-node.
+
+Design notes (TPU-first):
+- Columns stay on host (numpy) until a compute stage moves them to device; stages that
+  jit work shard the *array*, not the iterator — no per-row ingest loop (the reference's
+  per-value JNI loop at lightgbm/TrainUtils.scala:154-169 is the anti-pattern).
+- Vector-valued columns are plain 2-D arrays; images are 4-D (N,H,W,C). No boxed rows.
+- `map_partitions` exists for host-side / IO stages (serving, HTTP); numeric stages
+  should use whole-column ops instead.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+
+class Table:
+    """Immutable ordered collection of named columns with a partition count."""
+
+    def __init__(self, data: dict, npartitions: int = 1):
+        self._cols: dict[str, np.ndarray] = {}
+        nrows = None
+        for name, col in data.items():
+            arr = col if isinstance(col, np.ndarray) else np.asarray(col)
+            if nrows is None:
+                nrows = arr.shape[0] if arr.ndim else 0
+            elif arr.shape[0] != nrows:
+                raise ValueError(
+                    f"column {name!r} has {arr.shape[0]} rows, expected {nrows}")
+            self._cols[name] = arr
+        self._nrows = nrows or 0
+        if npartitions < 1:
+            raise ValueError("npartitions must be >= 1")
+        self.npartitions = int(npartitions)
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_pandas(cls, df, npartitions: int = 1) -> "Table":
+        return cls({name: df[name].to_numpy() for name in df.columns}, npartitions)
+
+    def to_pandas(self):
+        import pandas as pd
+        out = {}
+        for name, col in self._cols.items():
+            out[name] = list(col) if col.ndim > 1 else col
+        return pd.DataFrame(out)
+
+    # -- schema -------------------------------------------------------------
+    @property
+    def columns(self) -> list:
+        return list(self._cols)
+
+    def schema(self) -> dict:
+        return {n: (c.dtype, c.shape[1:]) for n, c in self._cols.items()}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __len__(self) -> int:
+        return self._nrows
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name not in self._cols:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        return self._cols[name]
+
+    def column(self, name: str) -> np.ndarray:
+        return self[name]
+
+    # -- functional updates -------------------------------------------------
+    def with_column(self, name: str, col) -> "Table":
+        arr = np.asarray(col)
+        if self._nrows and arr.shape[0] != self._nrows:
+            raise ValueError(
+                f"new column {name!r} has {arr.shape[0]} rows, table has {self._nrows}")
+        data = dict(self._cols)
+        data[name] = arr
+        return Table(data, self.npartitions)
+
+    def with_columns(self, cols: dict) -> "Table":
+        out = self
+        for k, v in cols.items():
+            out = out.with_column(k, v)
+        return out
+
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table({n: self._cols[n] for n in names}, self.npartitions)
+
+    def drop(self, *names: str) -> "Table":
+        return Table({n: c for n, c in self._cols.items() if n not in names},
+                     self.npartitions)
+
+    def rename(self, mapping: dict) -> "Table":
+        return Table({mapping.get(n, n): c for n, c in self._cols.items()},
+                     self.npartitions)
+
+    def filter(self, mask) -> "Table":
+        mask = np.asarray(mask)
+        return Table({n: c[mask] for n, c in self._cols.items()}, self.npartitions)
+
+    def take(self, n: int) -> "Table":
+        return Table({k: c[:n] for k, c in self._cols.items()}, self.npartitions)
+
+    def concat(self, other: "Table") -> "Table":
+        if set(other.columns) != set(self.columns):
+            raise ValueError("schema mismatch in concat")
+        return Table({n: np.concatenate([self._cols[n], other._cols[n]])
+                      for n in self.columns}, self.npartitions)
+
+    @staticmethod
+    def concat_all(tables: Sequence["Table"]) -> "Table":
+        if not tables:
+            raise ValueError("empty concat")
+        first = tables[0]
+        return Table({n: np.concatenate([t[n] for t in tables])
+                      for n in first.columns}, first.npartitions)
+
+    # -- partitioning (partition-as-device) ----------------------------------
+    def repartition(self, npartitions: int) -> "Table":
+        return Table(self._cols, npartitions)
+
+    def partition_bounds(self) -> list:
+        """Row ranges per partition; contiguous row blocks like Spark's coalesce."""
+        splits = np.linspace(0, self._nrows, self.npartitions + 1).astype(int)
+        return [(int(splits[i]), int(splits[i + 1])) for i in range(self.npartitions)]
+
+    def partitions(self) -> Iterable["Table"]:
+        for lo, hi in self.partition_bounds():
+            yield Table({n: c[lo:hi] for n, c in self._cols.items()}, 1)
+
+    def partition(self, i: int) -> "Table":
+        lo, hi = self.partition_bounds()[i]
+        return Table({n: c[lo:hi] for n, c in self._cols.items()}, 1)
+
+    def map_partitions(self, fn: Callable[["Table"], "Table"]) -> "Table":
+        """Host-side per-partition map (IO / serving stages). Numeric stages
+        should operate on whole columns and let sharding handle distribution."""
+        parts = [fn(p) for p in self.partitions()]
+        parts = [p for p in parts if p is not None and len(p.columns)]
+        out = Table.concat_all(parts)
+        return Table(out._cols, self.npartitions)
+
+    def shuffle(self, seed: int = 0) -> "Table":
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._nrows)
+        return Table({n: c[perm] for n, c in self._cols.items()}, self.npartitions)
+
+    def split(self, fraction: float, seed: int = 0):
+        """Random (train, test) split."""
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._nrows)
+        k = int(round(self._nrows * fraction))
+        a, b = perm[:k], perm[k:]
+        return (Table({n: c[a] for n, c in self._cols.items()}, self.npartitions),
+                Table({n: c[b] for n, c in self._cols.items()}, self.npartitions))
+
+    # -- misc ----------------------------------------------------------------
+    def find_unused_column_name(self, prefix: str) -> str:
+        """reference: core/schema/DatasetExtensions.scala:40"""
+        if prefix not in self._cols:
+            return prefix
+        i = 1
+        while f"{prefix}_{i}" in self._cols:
+            i += 1
+        return f"{prefix}_{i}"
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}:{c.dtype}{list(c.shape[1:]) or ''}"
+                         for n, c in self._cols.items())
+        return f"Table[{self._nrows} rows x {len(self._cols)} cols, p={self.npartitions}]({cols})"

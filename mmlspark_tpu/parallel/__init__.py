@@ -1,0 +1,7 @@
+from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, data_mesh, grid_mesh,
+                   full_mesh, row_sharding, replicated, pad_to_multiple,
+                   shard_rows, valid_row_mask, device_count)
+
+__all__ = ["DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "data_mesh", "grid_mesh",
+           "full_mesh", "row_sharding", "replicated", "pad_to_multiple",
+           "shard_rows", "valid_row_mask", "device_count"]

@@ -1,0 +1,93 @@
+"""Device-mesh topology helpers: the TPU-native replacement for ClusterUtil + rendezvous.
+
+The reference discovers cluster topology by interrogating the Spark driver
+(core/utils/ClusterUtil.scala:13-150) and forms worker rings with a driver-side
+ServerSocket rendezvous (lightgbm/LightGBMUtils.scala:119-188). On TPU none of that
+exists: jax.distributed has already formed the gang, and `jax.sharding.Mesh` names the
+topology. "partition <-> device" pinning replaces port arithmetic.
+
+Axis conventions used across the framework:
+    "data"  — batch/row sharding (dp); histogram/gradient psum rides ICI over it
+    "model" — tensor parallelism for the deep-net path (tp)
+    "seq"   — sequence/context parallelism (ring collectives) for long inputs
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def data_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over all (or the first n) devices; rows shard over it."""
+    devs = jax.devices()[: (n_devices or len(jax.devices()))]
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def grid_mesh(shape: Sequence[int], axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS)) -> Mesh:
+    """N-D mesh, e.g. (dp, tp) = (4, 2) on 8 devices."""
+    n = math.prod(shape)
+    devs = np.array(jax.devices()[:n]).reshape(shape)
+    return Mesh(devs, tuple(axis_names))
+
+
+def full_mesh(axis_names: Sequence[str], shape: Optional[Sequence[int]] = None) -> Mesh:
+    if shape is None:
+        shape = (len(axis_names) - 1) * (1,) + (jax.device_count(),)
+    return grid_mesh(shape, axis_names)
+
+
+def row_sharding(mesh: Mesh, axis: str = DATA_AXIS, ndim: int = 1) -> NamedSharding:
+    """Shard axis 0 (rows) over `axis`; replicate the rest."""
+    spec = P(axis, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
+    """Pad rows so they split evenly across devices; returns (padded, orig_len).
+
+    Static shapes are mandatory under jit — ragged partitions (which the reference
+    tolerates via 'ignore' ring members, lightgbm/TrainUtils.scala:577-580) become
+    padding + weight masks here.
+    """
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, rem)
+    return np.pad(arr, pad_width, constant_values=fill), n
+
+
+def shard_rows(mesh: Mesh, arr, axis_name: str = DATA_AXIS):
+    """Place a host array on the mesh, sharded along axis 0 (zero-padding if ragged).
+
+    Returns ``(device_array, n_valid_rows)`` — padded rows are zeros, so any
+    aggregate other than a sum needs the true count (or the mask from
+    `valid_row_mask`) to stay correct.
+    """
+    arr = np.asarray(arr)
+    nshards = mesh.shape[axis_name]
+    padded, n = pad_to_multiple(arr, nshards, 0)
+    return jax.device_put(padded, row_sharding(mesh, axis_name, padded.ndim)), n
+
+
+def valid_row_mask(n_padded: int, n_valid: int):
+    """float32 {1,0} mask marking real vs padding rows."""
+    import jax.numpy as jnp
+    return (jnp.arange(n_padded) < n_valid).astype(jnp.float32)

@@ -1,0 +1,49 @@
+"""Shared test session: 8 virtual CPU devices as the fake cluster.
+
+The reference's key testing idea (SURVEY.md §4): no real cluster anywhere —
+local[*] with partition-as-node exercises real distributed code paths. Here the
+equivalent is an 8-device virtual CPU mesh: every psum/all_gather/shard_map runs
+the real collective lowering, just on one host.
+"""
+import os
+
+# The image's sitecustomize registers the real-TPU plugin and sets
+# jax_platforms before any test code runs, so flip the config (not just env)
+# back to an 8-device virtual CPU before the backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def binary_table():
+    """Synthetic linearly-separable-ish binary classification table."""
+    from mmlspark_tpu import Table
+    rng = np.random.default_rng(0)
+    n = 2000
+    x = rng.normal(size=(n, 10)).astype(np.float32)
+    w = rng.normal(size=10)
+    logits = x @ w + 0.5 * np.sin(3 * x[:, 0]) * x[:, 1]
+    y = (logits + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return Table({"features": x, "label": y}, npartitions=4)
+
+
+@pytest.fixture(scope="session")
+def regression_table():
+    from mmlspark_tpu import Table
+    rng = np.random.default_rng(1)
+    n = 2000
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x[:, 0] * 2 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+         + rng.normal(scale=0.1, size=n)).astype(np.float32)
+    return Table({"features": x, "label": y}, npartitions=4)
