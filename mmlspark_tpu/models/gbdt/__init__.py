@@ -1,0 +1,23 @@
+from .booster import Booster
+from .boosting import BoostParams, Callbacks, fit_booster
+from .estimators import (GBDTClassifier, GBDTClassificationModel,
+                         GBDTRegressor, GBDTRegressionModel,
+                         GBDTRanker, GBDTRankerModel, load_native_model)
+from .trainer import Tree, TreeConfig, train_one_tree
+
+# familiar aliases for users of the reference
+LightGBMClassifier = GBDTClassifier
+LightGBMClassificationModel = GBDTClassificationModel
+LightGBMRegressor = GBDTRegressor
+LightGBMRegressionModel = GBDTRegressionModel
+LightGBMRanker = GBDTRanker
+LightGBMRankerModel = GBDTRankerModel
+
+__all__ = [
+    "Booster", "BoostParams", "Callbacks", "fit_booster", "Tree", "TreeConfig",
+    "train_one_tree", "GBDTClassifier", "GBDTClassificationModel",
+    "GBDTRegressor", "GBDTRegressionModel", "GBDTRanker", "GBDTRankerModel",
+    "load_native_model", "LightGBMClassifier", "LightGBMClassificationModel",
+    "LightGBMRegressor", "LightGBMRegressionModel", "LightGBMRanker",
+    "LightGBMRankerModel",
+]

@@ -1,0 +1,184 @@
+"""Booster: the serializable trained GBDT ensemble.
+
+Role-equivalent to the reference's LightGBMBooster
+(lightgbm/booster/LightGBMBooster.scala): holds the trees, scores rows,
+exposes leaf indices, SHAP-style contributions, feature importances, string
+round-trip, and merge for batch-continuation training
+(mergeBooster, LightGBMBooster.scala:237).
+
+Representation: dense stacked arrays (n_trees, max_nodes) — no pointers, no
+node objects — so predict is a single jitted scan (trainer.predict_raw) and
+persistence is plain npz.
+"""
+from __future__ import annotations
+
+import json
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from . import trainer
+
+
+class Booster(NamedTuple):
+    split_feature: np.ndarray   # (T, max_nodes) i32, -1 = leaf
+    threshold: np.ndarray       # (T, max_nodes) f32 real-valued bounds
+    split_bin: np.ndarray       # (T, max_nodes) i32 (train-time thresholds)
+    leaf_value: np.ndarray      # (T, max_nodes) f32
+    tree_class: np.ndarray      # (T,) i32 class id (0 for single-output)
+    max_depth: int
+    n_classes: int              # output width (1 for binary/regression margin)
+    objective: str
+    n_features: int
+    best_iteration: int = -1    # early stopping; -1 = use all trees
+
+    @property
+    def n_trees(self) -> int:
+        return self.split_feature.shape[0]
+
+    def _used_trees(self):
+        if self.best_iteration >= 0:
+            per_iter = max(self.n_classes, 1)
+            k = (self.best_iteration + 1) * per_iter
+            return slice(0, k)
+        return slice(None)
+
+    # -- scoring -----------------------------------------------------------
+    def raw_score(self, x, init_score: float = 0.0):
+        """(n, F) f32 -> (n, n_classes) raw margins."""
+        s = self._used_trees()
+        out = trainer.predict_raw(
+            np.asarray(x, dtype=np.float32),
+            self.split_feature[s], self.threshold[s], self.leaf_value[s],
+            self.tree_class[s], self.max_depth, self.n_classes)
+        return np.asarray(out) + init_score
+
+    def predict_leaf(self, x):
+        s = self._used_trees()
+        return np.asarray(trainer.predict_leaf_index(
+            np.asarray(x, dtype=np.float32),
+            self.split_feature[s], self.threshold[s], self.max_depth))
+
+    def feature_contributions(self, x):
+        """Per-feature additive contributions (SHAP-style path attribution,
+        reference: featuresShap, LightGBMBooster.scala). Computed by the
+        interventional 'Saabas' path method per tree, vectorized in numpy."""
+        x = np.asarray(x, dtype=np.float32)
+        n = x.shape[0]
+        contrib = np.zeros((n, self.n_features + 1), dtype=np.float64)
+        s = self._used_trees()
+        sf, thr, lv = self.split_feature[s], self.threshold[s], self.leaf_value[s]
+        for t in range(sf.shape[0]):
+            node = np.zeros(n, dtype=np.int64)
+            # expected value per node (bottom-up)
+            ev, cover = _node_expectations(sf[t], lv[t], self.max_depth)
+            contrib[:, -1] += ev[0]
+            for _ in range(self.max_depth):
+                f = sf[t][node]
+                leaf = f < 0
+                xf = x[np.arange(n), np.clip(f, 0, self.n_features - 1)]
+                child = np.where(xf <= thr[t][node], 2 * node + 1, 2 * node + 2)
+                nxt = np.where(leaf, node, child)
+                delta = ev[nxt] - ev[node]
+                valid = ~leaf
+                np.add.at(contrib, (np.arange(n), np.clip(f, 0, self.n_features - 1)),
+                          np.where(valid, delta, 0.0))
+                node = nxt
+        return contrib
+
+    # -- introspection ------------------------------------------------------
+    def feature_importances(self, importance_type: str = "split"):
+        s = self._used_trees()
+        sf = self.split_feature[s]
+        out = np.zeros(self.n_features, dtype=np.float64)
+        if importance_type == "split":
+            for f in range(self.n_features):
+                out[f] = np.sum(sf == f)
+        else:  # gain-proxy: sum of |leaf values| routed below splits of f
+            lv = np.abs(self.leaf_value[s]).sum()
+            for f in range(self.n_features):
+                out[f] = np.sum(sf == f) * lv / max((sf >= 0).sum(), 1)
+        return out
+
+    # -- persistence ---------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "meta": json.dumps({
+                "max_depth": self.max_depth, "n_classes": self.n_classes,
+                "objective": self.objective, "n_features": self.n_features,
+                "best_iteration": self.best_iteration}),
+            "split_feature": self.split_feature,
+            "threshold": self.threshold,
+            "split_bin": self.split_bin,
+            "leaf_value": self.leaf_value,
+            "tree_class": self.tree_class,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Booster":
+        meta = json.loads(str(d["meta"]))
+        return cls(split_feature=np.asarray(d["split_feature"]),
+                   threshold=np.asarray(d["threshold"]),
+                   split_bin=np.asarray(d["split_bin"]),
+                   leaf_value=np.asarray(d["leaf_value"]),
+                   tree_class=np.asarray(d["tree_class"]),
+                   **meta)
+
+    def save_model_string(self) -> str:
+        """Text round-trip (reference: saveToString, LightGBMBooster.scala:254)."""
+        d = self.to_dict()
+        return json.dumps({k: (v if isinstance(v, str) else np.asarray(v).tolist())
+                           for k, v in d.items()})
+
+    @classmethod
+    def load_model_string(cls, s: str) -> "Booster":
+        return cls.from_dict(json.loads(s))
+
+    def merge(self, other: "Booster") -> "Booster":
+        """Concatenate ensembles — batch-continuation training
+        (reference: mergeBooster, LightGBMBooster.scala:237)."""
+        assert self.n_classes == other.n_classes and self.n_features == other.n_features
+        md = max(self.max_depth, other.max_depth)
+        a, b = _pad_depth(self, md), _pad_depth(other, md)
+        # preserve early-stopping truncation: if the continuation booster was
+        # early-stopped, offset its best_iteration by our (fully used) iters
+        per_iter = max(self.n_classes, 1)
+        if other.best_iteration >= 0:
+            best = self.n_trees // per_iter + other.best_iteration
+        else:
+            best = -1
+        return Booster(
+            split_feature=np.concatenate([a[0], b[0]]),
+            threshold=np.concatenate([a[1], b[1]]),
+            split_bin=np.concatenate([a[2], b[2]]),
+            leaf_value=np.concatenate([a[3], b[3]]),
+            tree_class=np.concatenate([self.tree_class, other.tree_class]),
+            max_depth=md, n_classes=self.n_classes, objective=self.objective,
+            n_features=self.n_features, best_iteration=best)
+
+
+def _pad_depth(b: Booster, max_depth: int):
+    target = 2 ** (max_depth + 1) - 1
+    cur = b.split_feature.shape[1]
+    if cur == target:
+        return (b.split_feature, b.threshold, b.split_bin, b.leaf_value)
+    pad = target - cur
+
+    def p(a, fill):
+        return np.pad(a, ((0, 0), (0, pad)), constant_values=fill)
+    return (p(b.split_feature, -1), p(b.threshold, 0.0),
+            p(b.split_bin, 0), p(b.leaf_value, 0.0))
+
+
+def _node_expectations(sf, lv, max_depth):
+    """Cover-weighted expected value per heap node, approximated with uniform
+    child weights (exact covers aren't stored; adequate for contributions)."""
+    m = sf.shape[0]
+    ev = np.array(lv, dtype=np.float64)
+    cover = np.ones(m)
+    # bottom-up: internal node ev = mean of children
+    for i in range(m - 1, -1, -1):
+        l, r = 2 * i + 1, 2 * i + 2
+        if sf[i] >= 0 and r < m:
+            ev[i] = 0.5 * (ev[l] + ev[r])
+    return ev, cover

@@ -1,0 +1,5 @@
+from .binning import BinMapper, fit_bins, apply_bins, bin_threshold_value
+from .histogram import node_feature_histograms
+
+__all__ = ["BinMapper", "fit_bins", "apply_bins", "bin_threshold_value",
+           "node_feature_histograms"]

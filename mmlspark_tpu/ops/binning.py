@@ -1,0 +1,85 @@
+"""Quantile feature binning: float features -> uint8 bin ids + bin upper bounds.
+
+Role-equivalent to LightGBM's native BinMapper/Dataset construction, which the
+reference reaches through per-value JNI streaming (lightgbm/TrainUtils.scala:33-186,
+LightGBMUtils.scala:204-286 — `LGBM_DatasetCreateFromMats`). TPU-first design:
+binning happens once on host over whole columns (vectorized numpy, no row loop),
+producing a dense (n_rows, n_features) uint8 matrix that lives in HBM — 4-8x
+smaller than f32 features, which is what makes histogram building HBM-friendly.
+
+Bin semantics match LightGBM's: bin b holds values x <= upper_bound[b], the last
+bin is +inf, NaN maps to a dedicated missing bin (bin 0 by convention here, with
+`use_missing`), matching `zero_as_missing=False` defaults.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class BinMapper(NamedTuple):
+    """Per-feature binning decided on (a sample of) the training data."""
+    upper_bounds: np.ndarray   # (n_features, max_bin) f32; +inf padded
+    n_bins: np.ndarray         # (n_features,) actual bin count used
+    max_bin: int
+
+    @property
+    def n_features(self) -> int:
+        return self.upper_bounds.shape[0]
+
+
+def fit_bins(x: np.ndarray, max_bin: int = 255,
+             sample_cnt: int = 200_000, seed: int = 2) -> BinMapper:
+    """Choose at most max_bin quantile boundaries per feature.
+
+    LightGBM samples `bin_construct_sample_cnt` (default 200000) rows to find
+    boundaries; we do the same so 1B-row tables don't need a full pass.
+    """
+    n, f = x.shape
+    if n > sample_cnt:
+        rng = np.random.default_rng(seed)
+        x = x[rng.choice(n, sample_cnt, replace=False)]
+    ubs = np.full((f, max_bin), np.inf, dtype=np.float32)
+    nbins = np.zeros(f, dtype=np.int32)
+    for j in range(f):
+        col = x[:, j]
+        col = col[~np.isnan(col)]
+        uniq = np.unique(col)
+        if uniq.size <= 1:
+            nbins[j] = 1
+            continue
+        if uniq.size <= max_bin:
+            # distinct-value bins: boundary = midpoint between neighbors
+            bounds = (uniq[:-1] + uniq[1:]) / 2.0
+        else:
+            qs = np.linspace(0, 1, max_bin)[1:-1]
+            bounds = np.unique(np.quantile(col, qs))
+        k = min(bounds.size, max_bin - 1)
+        ubs[j, :k] = bounds[:k]
+        ubs[j, k:] = np.inf
+        nbins[j] = k + 1
+    return BinMapper(upper_bounds=ubs, n_bins=nbins, max_bin=max_bin)
+
+
+def apply_bins(mapper: BinMapper, x: np.ndarray) -> np.ndarray:
+    """Vectorized bin assignment: (n_rows, n_features) -> uint8 bins.
+
+    bin = searchsorted(upper_bounds, x, 'left'): value <= ub[b] lands in b.
+    NaN lands in the last bin of each feature (treated as largest, matching
+    LightGBM's default missing handling direction).
+    """
+    n, f = x.shape
+    out = np.empty((n, f), dtype=np.uint8)
+    for j in range(f):
+        k = int(mapper.n_bins[j])
+        b = np.searchsorted(mapper.upper_bounds[j, : max(k - 1, 0)], x[:, j],
+                            side="left")
+        b = np.where(np.isnan(x[:, j]), k - 1, b)
+        out[:, j] = b.astype(np.uint8)
+    return out
+
+
+def bin_threshold_value(mapper: BinMapper, feature: int, bin_id: int) -> float:
+    """Real-valued decision threshold for 'go left if bin <= bin_id'."""
+    return float(mapper.upper_bounds[feature, bin_id])

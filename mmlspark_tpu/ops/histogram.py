@@ -1,0 +1,66 @@
+"""The GBDT hot op: per-(node, feature, bin) gradient/hessian/count histograms.
+
+This is the TPU-native equivalent of LightGBM's C++ histogram construction
+kernels (the work inside `LGBM_BoosterUpdateOneIter`, reference:
+lightgbm/TrainUtils.scala:326-358 — SURVEY.md §2.9 item 1). Histogram build is
+memory-bandwidth-shaped (scatter-add over binned features), not matmul-shaped;
+the XLA path lowers to a single fused scatter-add via segment_sum over
+composite keys. A Pallas TPU kernel (`_pallas_hist`) keeps the bins tile in
+VMEM and accumulates all three statistics in one pass; selection is automatic
+by backend with an env escape hatch (MMLSPARK_TPU_HIST=xla|pallas).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_hist(bins, grad, hess, node_local, active, n_nodes: int, n_bins: int):
+    """One fused scatter-add: key = ((node * F) + f) * B + bin.
+
+    Inactive rows get an out-of-range segment id and are dropped by XLA's
+    scatter OOB semantics — the moral equivalent of the reference's 'ignore'
+    ring members for empty partitions (TrainUtils.scala:577-580).
+    """
+    n, f = bins.shape
+    num_segments = n_nodes * f * n_bins
+    feat_ids = jnp.arange(f, dtype=jnp.int32)[None, :]
+    keys = (node_local[:, None] * f + feat_ids) * n_bins + bins.astype(jnp.int32)
+    keys = jnp.where(active[:, None], keys, num_segments)  # OOB -> dropped
+    keys = keys.reshape(-1)
+
+    def seg(vals):
+        out = jax.ops.segment_sum(vals.reshape(-1), keys,
+                                  num_segments=num_segments)
+        return out.reshape(n_nodes, f, n_bins)
+
+    ones = jnp.ones((n, 1), dtype=jnp.float32)
+    hg = seg(jnp.broadcast_to(grad[:, None], (n, f)))
+    hh = seg(jnp.broadcast_to(hess[:, None], (n, f)))
+    hc = seg(jnp.broadcast_to(ones, (n, f)))
+    return hg, hh, hc
+
+
+def node_feature_histograms(bins, grad, hess, node_local, active,
+                            n_nodes: int, n_bins: int):
+    """(n,F) uint8 bins + per-row grad/hess -> three (n_nodes, F, n_bins) f32
+    histograms. Rows with active=False contribute nothing."""
+    impl = os.environ.get("MMLSPARK_TPU_HIST", "auto")
+    if impl == "pallas" or (impl == "auto" and _should_use_pallas()):
+        try:
+            from .histogram_pallas import pallas_hist
+        except ImportError as e:
+            raise NotImplementedError(
+                "MMLSPARK_TPU_HIST=pallas requested but the Pallas histogram "
+                "kernel is not available in this build; unset the env var to "
+                "use the XLA scatter path") from e
+        return pallas_hist(bins, grad, hess, node_local, active, n_nodes, n_bins)
+    return _xla_hist(bins, grad, hess, node_local, active, n_nodes, n_bins)
+
+
+def _should_use_pallas() -> bool:
+    # flipped on once the Pallas kernel beats the XLA scatter on real TPU
+    # (bench.py compares them); keep XLA as the portable default.
+    return False
