@@ -14,6 +14,7 @@ learning rate, mirroring LightGBMDelegate (lightgbm/LightGBMDelegate.scala).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -26,7 +27,7 @@ from . import trainer
 from .booster import Booster
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class BoostParams:
     objective: str = "binary"
     boosting: str = "gbdt"            # gbdt | rf | dart | goss
@@ -101,6 +102,178 @@ def _eval_metric(name, objective, margin, y, num_class):
     return float(((m.squeeze() - y) ** 2).mean()), False
 
 
+# objectives whose leaf outputs are refit host-side (median/quantile renewal)
+RENEWAL_OBJECTIVES = ("regression_l1", "quantile", "huber")
+
+
+def _grad_hess(p: BoostParams, margin, y_j, y_onehot, g_idx):
+    if p.objective == "multiclass":
+        return obj_mod.multiclass_grad_hess(margin, y_onehot)
+    if p.objective == "binary":
+        return obj_mod.binary_grad_hess(margin, y_j, p.sigmoid)
+    if p.objective == "lambdarank":
+        return obj_mod.lambdarank_grad_hess(margin, y_j, g_idx, sigmoid=p.sigmoid)
+    if p.objective in ("huber", "quantile"):
+        return obj_mod.OBJECTIVES[p.objective](margin, y_j, p.alpha)
+    if p.objective == "tweedie":
+        return obj_mod.tweedie_grad_hess(margin, y_j, p.tweedie_variance_power)
+    return obj_mod.OBJECTIVES[p.objective](margin, y_j)
+
+
+def _row_weights(p: BoostParams, grad, key, it_offset, multiclass):
+    """Per-iteration GOSS / bagging row weights (None = keep all)."""
+    n = grad.shape[0]
+    if p.boosting == "goss":
+        g_abs = jnp.abs(grad).sum(-1) if multiclass else jnp.abs(grad)
+        n_top = max(int(p.top_rate * n), 1)
+        thresh = jnp.sort(g_abs)[-n_top]
+        is_top = g_abs >= thresh
+        rnd = jax.random.uniform(key, (n,))
+        keep_other = (~is_top) & (rnd < p.other_rate / max(1 - p.top_rate, 1e-9))
+        amp = (1.0 - p.top_rate) / max(p.other_rate, 1e-9)
+        return jnp.where(is_top, 1.0, jnp.where(keep_other, amp, 0.0))
+    rf = p.boosting == "rf"
+    if p.bagging_fraction < 1.0 and (rf or p.bagging_freq > 0):
+        w = (jax.random.uniform(key, (n,)) < p.bagging_fraction).astype(jnp.float32)
+        if rf or p.bagging_freq == 1:
+            return w
+        do_bag = (it_offset % p.bagging_freq) == 0  # traced under scan
+        return jnp.where(do_bag, w, jnp.ones(n, jnp.float32))
+    return None
+
+
+def _feature_mask(p: BoostParams, key, n_features):
+    if p.feature_fraction < 1.0:
+        kf = max(1, int(round(p.feature_fraction * n_features)))
+        perm = jax.random.permutation(key, n_features)
+        return jnp.zeros(n_features, bool).at[perm[:kf]].set(True)
+    return jnp.ones(n_features, bool)
+
+
+def _device_metric(name, objective, margin, y, num_class):
+    """(metric_value, larger_is_better) — computed in-graph so eval never
+    forces a host round-trip inside the fused loop."""
+    if name is None:
+        name = {"binary": "binary_logloss", "multiclass": "multi_logloss",
+                "lambdarank": "l2"}.get(objective, "l2")
+    larger = name == "auc"
+    if name == "auc":
+        order = jnp.argsort(margin)
+        ranks = jnp.zeros_like(margin).at[order].set(
+            jnp.arange(1, margin.shape[0] + 1, dtype=margin.dtype))
+        npos = y.sum()
+        nneg = y.shape[0] - npos
+        val = (jnp.sum(jnp.where(y == 1, ranks, 0.0)) - npos * (npos + 1) / 2) \
+            / jnp.maximum(npos * nneg, 1.0)
+    elif name == "binary_logloss":
+        pr = jnp.clip(jax.nn.sigmoid(margin), 1e-15, 1 - 1e-15)
+        val = -(y * jnp.log(pr) + (1 - y) * jnp.log(1 - pr)).mean()
+    elif name == "multi_logloss":
+        logp = jax.nn.log_softmax(margin, axis=-1)
+        val = -jnp.take_along_axis(logp, y.astype(jnp.int32)[:, None],
+                                   axis=1).mean()
+    else:
+        m = margin if margin.ndim == 1 else margin[:, 0]
+        val = ((m - y) ** 2).mean()
+    return val, larger
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("p", "cfg", "chunk_len", "k_out", "axis_name",
+                     "has_valid", "voting_top_k"))
+def _boost_chunk(d_bins, y_j, w_j, margin, init_margin, v_bins, vy, v_margin,
+                 key, it_base, p: BoostParams, cfg, chunk_len: int, k_out: int,
+                 axis_name=None, has_valid: bool = False,
+                 voting_top_k=None):
+    """One fused chunk of boosting iterations: a lax.scan with NO host
+    round-trips — the design that actually fits the TPU (the reference's
+    per-iteration JNI hot loop, TrainUtils.scala:360-427, becomes one XLA
+    program; the ~100ms/dispatch host<->device latency is paid once per
+    chunk instead of once per tree)."""
+    multiclass = p.objective == "multiclass"
+    y_onehot = (jax.nn.one_hot(y_j.astype(jnp.int32), p.num_class,
+                               dtype=jnp.float32) if multiclass else None)
+    rf = p.boosting == "rf"
+
+    def one_iter(carry, inp):
+        margin, v_margin = carry
+        it, key_it = inp
+        k_bag, k_feat = jax.random.split(key_it)
+        if axis_name:  # decorrelate per-shard sampling
+            k_bag = jax.random.fold_in(k_bag, jax.lax.axis_index(axis_name))
+        # rf trees are independent: gradients always at the initial margin
+        g_margin = init_margin if rf else margin
+        grad, hess = _grad_hess(p, g_margin, y_j, y_onehot, None)
+        if w_j is not None:
+            grad = grad * (w_j[:, None] if multiclass else w_j)
+            hess = hess * (w_j[:, None] if multiclass else w_j)
+        row_w = _row_weights(p, grad, k_bag, it, multiclass)
+        if row_w is not None:
+            grad = grad * (row_w[:, None] if multiclass else row_w)
+            hess = hess * (row_w[:, None] if multiclass else row_w)
+        fmask = _feature_mask(p, k_feat, cfg.n_features)
+
+        sfs, sbs, lvs = [], [], []
+        for k in range(k_out):
+            gk = grad[:, k] if multiclass else grad
+            hk = hess[:, k] if multiclass else hess
+            tree, delta = trainer.train_one_tree(d_bins, gk, hk, fmask, cfg,
+                                                 axis_name=axis_name,
+                                                 voting_top_k=voting_top_k)
+            sfs.append(tree.split_feature)
+            sbs.append(tree.split_bin)
+            lvs.append(tree.leaf_value)
+            if multiclass:
+                margin = margin.at[:, k].add(delta)
+            else:
+                margin = margin + delta
+            if has_valid:
+                vd = trainer.predict_binned(v_bins, tree.split_feature,
+                                            tree.split_bin, tree.leaf_value,
+                                            cfg.max_depth)
+                if multiclass:
+                    v_margin = v_margin.at[:, k].add(vd)
+                else:
+                    v_margin = v_margin + vd
+        if has_valid:
+            metric, _ = _device_metric(p.metric, p.objective, v_margin, vy,
+                                       p.num_class)
+        else:
+            metric = jnp.float32(0.0)
+        out = (jnp.stack(sfs), jnp.stack(sbs), jnp.stack(lvs), metric)
+        return (margin, v_margin), out
+
+    its = it_base + jnp.arange(chunk_len)
+    keys = jax.random.split(key, chunk_len)
+    (margin, v_margin), (sf, sb, lv, metrics) = jax.lax.scan(
+        one_iter, (margin, v_margin), (its, keys))
+    # (chunk, K, max_nodes) -> (chunk*K, max_nodes), class-major per iteration
+    sf = sf.reshape(-1, sf.shape[-1])
+    sb = sb.reshape(-1, sb.shape[-1])
+    lv = lv.reshape(-1, lv.shape[-1])
+    return margin, v_margin, sf, sb, lv, metrics
+
+
+def _build_booster(sf, sb, lv, tree_classes, mapper, p: BoostParams,
+                   k_out: int, n_features: int, best_iter: int,
+                   init_booster, base):
+    """Stacked tree arrays -> Booster with real-valued thresholds."""
+    thr = mapper.upper_bounds[np.clip(sf, 0, n_features - 1),
+                              np.clip(sb, 0, p.max_bin - 1)]
+    thr = np.where(sf >= 0, thr, 0.0).astype(np.float32)
+    booster = Booster(split_feature=sf.astype(np.int32), threshold=thr,
+                      split_bin=sb.astype(np.int32),
+                      leaf_value=lv.astype(np.float32),
+                      tree_class=np.asarray(tree_classes, np.int32),
+                      max_depth=p.max_depth, n_classes=k_out,
+                      objective=p.objective, n_features=n_features,
+                      best_iteration=best_iter)
+    if init_booster is not None:
+        booster = init_booster.merge(booster)
+    return booster
+
+
 def fit_booster(x: np.ndarray, y: np.ndarray,
                 params: BoostParams,
                 weights: Optional[np.ndarray] = None,
@@ -109,7 +282,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 valid: Optional[tuple] = None,
                 init_booster: Optional[Booster] = None,
                 callbacks: Optional[Callbacks] = None,
-                tree_fn=None, put_fn=None):
+                tree_fn=None, put_fn=None, chunk_fn=None,
+                prebinned: Optional[tuple] = None):
     """Train a Booster on host arrays. Single-device by default; the
     distributed path (distributed.py) passes a shard_map-wrapped `tree_fn`
     and a sharding `put_fn`, and this same loop runs over the mesh.
@@ -123,12 +297,18 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     multiclass = p.objective == "multiclass"
     k_out = p.num_class if multiclass else 1
     put = put_fn or jnp.asarray
+    custom_tree_fn = tree_fn is not None
     if tree_fn is None:
         tree_fn = lambda b, g, h, fm, cfg: trainer.train_one_tree(b, g, h, fm, cfg)
 
-    mapper = binning.fit_bins(x, max_bin=p.max_bin, seed=p.seed)
-    bins = binning.apply_bins(mapper, x)
-    d_bins = put(bins)
+    if prebinned is not None:
+        # (mapper, device_bins): data already staged on device — training
+        # throughput can then be measured without the host->device copy
+        mapper, d_bins = prebinned
+        d_bins = put(d_bins)
+    else:
+        mapper = binning.fit_bins(x, max_bin=p.max_bin, seed=p.seed)
+        d_bins = put(binning.apply_bins_device(mapper, x))
     y_j = put(np.asarray(y, dtype=np.float32))
     w_j = None if weights is None else put(np.asarray(weights, dtype=np.float32))
     # lambdarank: the padded per-group gather layout is computed once, host-side
@@ -176,11 +356,80 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     goss = p.boosting == "goss"
     key = jax.random.PRNGKey(p.seed)
 
+    # ---- fused path: whole boosting loop as chunked lax.scan (no host in
+    # the loop). Host-loop fallback covers DART (needs per-tree delta
+    # history), L1-family leaf renewal, lambdarank, and delegate callbacks.
+    use_fused = (callbacks is None and not dart
+                 and p.objective not in RENEWAL_OBJECTIVES
+                 and p.objective != "lambdarank"
+                 and (chunk_fn is not None or not custom_tree_fn))
+    if use_fused:
+        eval_history = []
+        fused = chunk_fn or _boost_chunk
+        cfg = trainer.TreeConfig(
+            learning_rate=(1.0 / p.num_iterations if rf else p.learning_rate),
+            **cfg_base)
+        if has_valid:
+            vy_j = jnp.asarray(np.asarray(vy, np.float32))
+            v_bins_, v_margin_ = v_bins, v_margin
+        else:  # static dummies; has_valid=False branches never read them
+            v_bins_ = jnp.zeros((1, n_features), jnp.uint8)
+            vy_j = jnp.zeros((1,), jnp.float32)
+            v_margin_ = jnp.zeros((1, p.num_class) if multiclass else (1,),
+                                  jnp.float32)
+        mname = p.metric or {"binary": "binary_logloss",
+                             "multiclass": "multi_logloss"}.get(p.objective, "l2")
+        larger = mname == "auc"
+        patience = p.early_stopping_round
+        track = has_valid and (patience > 0 or p.metric is not None)
+        chunk = (max(patience, 16) if (track and patience > 0)
+                 else p.num_iterations)
+        parts, stop_at = [], None
+        best_metric, best_iter, rounds_since = None, -1, 0
+        it = 0
+        margin_init = margin  # rf gradients stay at the pre-loop margin
+        while it < p.num_iterations:
+            clen = min(chunk, p.num_iterations - it)
+            key, kc = jax.random.split(key)
+            margin, v_margin_, sf_c, sb_c, lv_c, mts = fused(
+                d_bins, y_j, w_j, margin, margin_init, v_bins_, vy_j,
+                v_margin_, kc, it, p, cfg, clen, k_out, has_valid=has_valid)
+            parts.append((sf_c, sb_c, lv_c))
+            if track:
+                for i, mv in enumerate(np.asarray(mts)):
+                    mv = float(mv)
+                    eval_history.append(mv)
+                    improved = (best_metric is None
+                                or ((mv > best_metric) == larger
+                                    and mv != best_metric))
+                    if improved:
+                        best_metric, best_iter, rounds_since = mv, it + i, 0
+                    else:
+                        rounds_since += 1
+                        if patience > 0 and rounds_since >= patience:
+                            stop_at = it + i + 1
+                            break
+            it += clen
+            if stop_at is not None:
+                break
+        sf = np.concatenate([np.asarray(s) for s, _, _ in parts])
+        sb = np.concatenate([np.asarray(s) for _, s, _ in parts])
+        lv = np.concatenate([np.asarray(s) for _, _, s in parts])
+        if stop_at is not None:  # drop trees grown past the stopping point
+            sf, sb, lv = sf[:stop_at * k_out], sb[:stop_at * k_out], lv[:stop_at * k_out]
+        tree_classes = np.tile(np.arange(k_out, dtype=np.int32),
+                               sf.shape[0] // max(k_out, 1))
+        booster = _build_booster(
+            sf, sb, lv, tree_classes, mapper, p, k_out, n_features,
+            best_iter if (track and patience > 0) else -1, init_booster, base)
+        return booster, base, eval_history
+
     trees, tree_classes, train_deltas = [], [], []
     dart_weights: list = []
     val_deltas: list = []  # per-iteration val-set deltas (DART reweighting)
     best_metric, best_iter, rounds_since = None, -1, 0
     eval_history = []
+    init_margin = margin
 
     n_grown = 0
     for it in range(p.num_iterations):
@@ -205,57 +454,26 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             margin_used = margin
             for t_i in dropped:
                 margin_used = margin_used - train_deltas[t_i] * dart_weights[t_i]
+        elif rf:
+            # rf trees are independent: gradients at the initial margin
+            margin_used = init_margin
         else:
             margin_used = margin
 
         # gradients at the current (possibly dropped) margin
-        if multiclass:
-            grad, hess = obj_mod.multiclass_grad_hess(margin_used, y_onehot)
-        elif p.objective == "binary":
-            grad, hess = obj_mod.binary_grad_hess(margin_used, y_j, p.sigmoid)
-        elif p.objective == "lambdarank":
-            grad, hess = obj_mod.lambdarank_grad_hess(margin_used, y_j, g_idx,
-                                                      sigmoid=p.sigmoid)
-        elif p.objective in ("huber", "quantile"):
-            fn = obj_mod.OBJECTIVES[p.objective]
-            grad, hess = fn(margin_used, y_j, p.alpha)
-        elif p.objective == "tweedie":
-            grad, hess = obj_mod.tweedie_grad_hess(margin_used, y_j,
-                                                   p.tweedie_variance_power)
-        else:
-            fn = obj_mod.OBJECTIVES[p.objective]
-            grad, hess = fn(margin_used, y_j)
+        grad, hess = _grad_hess(p, margin_used, y_j,
+                                y_onehot if multiclass else None, g_idx)
         if w_j is not None:
             grad = grad * (w_j[:, None] if multiclass else w_j)
             hess = hess * (w_j[:, None] if multiclass else w_j)
 
-        # row sampling: bagging or GOSS
-        row_w = None
-        if goss:
-            g_abs = jnp.abs(grad).sum(-1) if multiclass else jnp.abs(grad)
-            n_top = int(p.top_rate * n)
-            n_other = int(p.other_rate * n)
-            thresh = jnp.sort(g_abs)[-max(n_top, 1)]
-            is_top = g_abs >= thresh
-            rnd = jax.random.uniform(k_bag, (n,))
-            keep_other = (~is_top) & (rnd < p.other_rate / max(1 - p.top_rate, 1e-9))
-            amp = (1.0 - p.top_rate) / max(p.other_rate, 1e-9)
-            row_w = jnp.where(is_top, 1.0, jnp.where(keep_other, amp, 0.0))
-        elif (p.bagging_fraction < 1.0
-              and (rf or (p.bagging_freq > 0 and it % p.bagging_freq == 0))):
-            row_w = (jax.random.uniform(k_bag, (n,))
-                     < p.bagging_fraction).astype(jnp.float32)
+        # row sampling: bagging or GOSS (shared with the fused path)
+        row_w = _row_weights(p, grad, k_bag, it, multiclass)
         if row_w is not None:
             grad = grad * (row_w[:, None] if multiclass else row_w)
             hess = hess * (row_w[:, None] if multiclass else row_w)
 
-        # feature sampling
-        if p.feature_fraction < 1.0:
-            kf = max(1, int(round(p.feature_fraction * n_features)))
-            perm = jax.random.permutation(k_feat, n_features)
-            fmask = jnp.zeros(n_features, bool).at[perm[:kf]].set(True)
-        else:
-            fmask = jnp.ones(n_features, bool)
+        fmask = _feature_mask(p, k_feat, n_features)
 
         cfg = trainer.TreeConfig(learning_rate=lr, **cfg_base)
         it_deltas = jnp.zeros_like(margin)

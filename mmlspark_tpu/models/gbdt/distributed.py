@@ -57,6 +57,25 @@ def make_sharded_tree_fn(mesh, parallelism: str = "data_parallel",
     return tree_fn
 
 
+@functools.lru_cache(maxsize=128)
+def _compiled_chunk_fn(mesh, p, cfg, chunk_len: int, k_out: int,
+                       has_valid: bool, multiclass: bool, voting):
+    """shard_map-wrapped fused boosting chunk (see boosting._boost_chunk):
+    rows sharded over the data axis, trees/metrics replicated out."""
+    from .boosting import _boost_chunk
+    fn = functools.partial(_boost_chunk, p=p, cfg=cfg, chunk_len=chunk_len,
+                           k_out=k_out, axis_name=DATA_AXIS,
+                           has_valid=has_valid, voting_top_k=voting)
+    margin_spec = P(DATA_AXIS, None) if multiclass else P(DATA_AXIS)
+    mapped = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), margin_spec,
+                  margin_spec, P(), P(), P(), P(), P()),
+        out_specs=(margin_spec, P(), P(), P(), P(), P()),
+        check_rep=False)
+    return jax.jit(mapped)
+
+
 def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
                             group=None, valid=None, init_booster=None,
                             callbacks=None, parallelism: str = "data_parallel",
@@ -93,8 +112,19 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     tree_fn = make_sharded_tree_fn(mesh, parallelism, top_k)
+    voting = top_k if parallelism == "voting_parallel" else None
+    multiclass = params.objective == "multiclass"
+
+    def chunk_fn(d_bins, y_j, w_j, margin, margin_init, v_bins, vy, v_margin,
+                 key, it_base, p, cfg, chunk_len, k_out, has_valid=False):
+        compiled = _compiled_chunk_fn(mesh, p, cfg, chunk_len, k_out,
+                                      has_valid, multiclass, voting)
+        import jax.numpy as jnp
+        return compiled(d_bins, y_j, w_j, margin, margin_init, v_bins, vy,
+                        v_margin, key, jnp.int32(it_base))
+
     booster, base, hist = fit_booster(
         x_p, y_p, params, weights=w_p, init_scores=init_p, group=group_p,
         valid=valid, init_booster=init_booster, callbacks=callbacks,
-        tree_fn=tree_fn, put_fn=put_rows)
+        tree_fn=tree_fn, put_fn=put_rows, chunk_fn=chunk_fn)
     return booster, base, hist

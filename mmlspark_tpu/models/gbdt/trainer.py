@@ -163,33 +163,63 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name else x
 
+    voting = bool(axis_name and voting_top_k)
+    prev_hists = None   # full (m, F, B) hists of the previous level (psum'd)
+    prev_apply = None   # which previous-level nodes actually split
+
+    def _interleave(left, sub):
+        """(m/2,F,B) left-child + sibling hists -> (m,F,B) interleaved."""
+        return jnp.stack([left, sub], axis=1).reshape(
+            left.shape[0] * 2, *left.shape[1:])
+
     for depth in range(cfg.max_depth):
         level_base = 2 ** depth - 1
         m = 2 ** depth
         node_local = node_of_row - level_base
         active = (node_local >= 0) & (node_local < m)
 
-        hg, hh, hc = node_feature_histograms(
-            bins, grad, hess, node_local, active, m, cfg.n_bins)
-        level_fmask = feature_mask
-        if axis_name and voting_top_k:
-            voted = _voting_feature_mask(hg, hh, hc, feature_mask, cfg,
-                                         voting_top_k, axis_name)
-            # zero non-voted features before the all-reduce (comm saving)
-            keep = voted[:, :, None]
-            hg, hh, hc = hg * keep, hh * keep, hc * keep
-            level_fmask = jnp.ones_like(feature_mask)  # gating now per (m,F)
-        hg, hh, hc = psum(hg), psum(hh), psum(hc)
+        if depth == 0 or voting:
+            # full histogram pass (voting masks features pre-psum, which is
+            # incompatible with sibling subtraction)
+            hg, hh, hc = node_feature_histograms(
+                bins, grad, hess, node_local, active, m, cfg.n_bins)
+            if voting:
+                parent_g = psum(hg[:, 0].sum(-1))
+                parent_h = psum(hh[:, 0].sum(-1))
+                parent_c = psum(hc[:, 0].sum(-1))
+                voted = _voting_feature_mask(hg, hh, hc, feature_mask, cfg,
+                                             voting_top_k, axis_name)
+                keep = voted[:, :, None]
+                hg, hh, hc = psum(hg * keep), psum(hh * keep), psum(hc * keep)
+            else:
+                hg, hh, hc = psum(hg), psum(hh), psum(hc)
+                parent_g, parent_h, parent_c = (hg[:, 0].sum(-1),
+                                                hh[:, 0].sum(-1),
+                                                hc[:, 0].sum(-1))
+            child_valid = jnp.ones(m, bool)
+        else:
+            # histogram subtraction (LightGBM's halving trick): build hists
+            # for LEFT children only (even node_local), derive siblings as
+            # parent - left. Halves both compute and psum volume per level.
+            left_active = active & (node_local % 2 == 0)
+            lg, lh, lc = node_feature_histograms(
+                bins, grad, hess, node_local // 2, left_active, m // 2,
+                cfg.n_bins)
+            lg, lh, lc = psum(lg), psum(lh), psum(lc)
+            hg = _interleave(lg, prev_hists[0] - lg)
+            hh = _interleave(lh, prev_hists[1] - lh)
+            hc = _interleave(lc, prev_hists[2] - lc)
+            # children of non-split nodes inherit garbage hists — mask them
+            child_valid = jnp.repeat(prev_apply, 2)
+            parent_g, parent_h, parent_c = (hg[:, 0].sum(-1),
+                                            hh[:, 0].sum(-1),
+                                            hc[:, 0].sum(-1))
+        level_fmask = feature_mask if not voting else jnp.ones_like(feature_mask)
 
-        parent_g = psum(jax.ops.segment_sum(grad, jnp.where(active, node_local, m),
-                                            num_segments=m + 1))[:m]
-        parent_h = psum(jax.ops.segment_sum(hess, jnp.where(active, node_local, m),
-                                            num_segments=m + 1))[:m]
-        parent_c = psum(jax.ops.segment_sum(
-            active.astype(jnp.float32), jnp.where(active, node_local, m),
-            num_segments=m + 1))[:m]
         gain, feat, thr = _best_splits_for_level(
             hg, hh, hc, level_fmask, cfg, parent_g, parent_h, parent_c)
+        gain = jnp.where(child_valid, gain, -jnp.inf)
+        prev_hists = (hg, hh, hc)
 
         valid = (gain > cfg.min_gain_to_split) & jnp.isfinite(gain)
         # leaf budget: each applied split adds one leaf; rank by gain
@@ -198,33 +228,48 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         budget = cfg.num_leaves - leaf_count
         apply = valid & (rank < budget)
         leaf_count = leaf_count + apply.sum().astype(jnp.int32)
+        prev_apply = apply
 
         heap_ids = level_base + jnp.arange(m)
         split_feature = split_feature.at[heap_ids].set(
             jnp.where(apply, feat, -1))
         split_bin = split_bin.at[heap_ids].set(jnp.where(apply, thr, 0))
 
-        # advance rows whose node split
-        row_feat = feat[jnp.clip(node_local, 0, m - 1)]
-        row_thr = thr[jnp.clip(node_local, 0, m - 1)]
-        row_apply = active & apply[jnp.clip(node_local, 0, m - 1)]
-        row_bin = jnp.take_along_axis(
-            bins, jnp.clip(row_feat, 0, cfg.n_features - 1)[:, None],
-            axis=1)[:, 0].astype(jnp.int32)
+        # advance rows whose node split. All row-wise lookups are one-hot
+        # contractions, not gathers — TPU gathers over n rows are serial,
+        # one-hot multiplies ride the VPU/MXU.
+        node_oh = jax.nn.one_hot(node_local, m, dtype=jnp.float32)  # 0s if inactive
+        tbl = jnp.stack([feat.astype(jnp.float32), thr.astype(jnp.float32),
+                         apply.astype(jnp.float32)], axis=1)  # (m, 3)
+        # HIGHEST precision: bf16 operands would round feature ids > 256
+        rows = jnp.matmul(node_oh, tbl,
+                          precision=jax.lax.Precision.HIGHEST)  # (n, 3)
+        row_feat = rows[:, 0].astype(jnp.int32)
+        row_thr = rows[:, 1].astype(jnp.int32)
+        row_apply = active & (rows[:, 2] > 0.5)
+        feat_oh = jax.nn.one_hot(row_feat, cfg.n_features, dtype=jnp.float32)
+        # elementwise multiply-reduce (not a dot) — stays exact in f32
+        row_bin = jnp.sum(bins.astype(jnp.float32) * feat_oh,
+                          axis=1).astype(jnp.int32)
         go_left = row_bin <= row_thr
         child = jnp.where(go_left, 2 * node_of_row + 1, 2 * node_of_row + 2)
         node_of_row = jnp.where(row_apply, child, node_of_row)
 
-    # leaf values from resting nodes (shrinkage applied here, like LightGBM)
-    seg_g = psum(jax.ops.segment_sum(grad, node_of_row, num_segments=cfg.max_nodes))
-    seg_h = psum(jax.ops.segment_sum(hess, node_of_row, num_segments=cfg.max_nodes))
+    # leaf values from resting nodes (shrinkage applied here, like LightGBM);
+    # segment sums and the delta lookup as one-hot matmuls, not scatters
+    rest_oh = jax.nn.one_hot(node_of_row, cfg.max_nodes, dtype=jnp.float32)
+    gh = jnp.stack([grad, hess], axis=1)  # (n, 2)
+    sums = psum(jax.lax.dot_general(rest_oh, gh, (((0,), (0,)), ((), ())),
+                                    precision=jax.lax.Precision.HIGHEST))
+    seg_g, seg_h = sums[:, 0], sums[:, 1]
     leaf_value = (-cfg.learning_rate * _soft_threshold(seg_g, cfg.lambda_l1)
                   / (seg_h + cfg.lambda_l2 + 1e-12))
     leaf_value = jnp.where(seg_h > 0, leaf_value, 0.0)
 
     tree = Tree(split_feature=split_feature, split_bin=split_bin,
                 leaf_value=leaf_value)
-    delta = leaf_value[node_of_row]
+    delta = jnp.matmul(rest_oh, leaf_value[:, None],
+                       precision=jax.lax.Precision.HIGHEST)[:, 0]
     return tree, delta
 
 

@@ -83,3 +83,37 @@ def apply_bins(mapper: BinMapper, x: np.ndarray) -> np.ndarray:
 def bin_threshold_value(mapper: BinMapper, feature: int, bin_id: int) -> float:
     """Real-valued decision threshold for 'go left if bin <= bin_id'."""
     return float(mapper.upper_bounds[feature, bin_id])
+
+
+_assign_bins_jit = None
+
+
+def _get_assign_bins():
+    """Module-level jitted assigner so repeated fits hit the jit cache
+    (a per-call closure would retrace + recompile every training run)."""
+    global _assign_bins_jit
+    if _assign_bins_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _assign(ub, nb, xd):
+            def one_feature(ub_j, nb_j, col):
+                b = jnp.searchsorted(ub_j, col, side="left")
+                b = jnp.where(jnp.isnan(col), nb_j - 1, b)
+                return jnp.minimum(b, nb_j - 1)
+            out = jax.vmap(one_feature, in_axes=(0, 0, 1), out_axes=1)(ub, nb, xd)
+            return out.astype(jnp.uint8)
+
+        _assign_bins_jit = _assign
+    return _assign_bins_jit
+
+
+def apply_bins_device(mapper: BinMapper, x):
+    """Device-side bin assignment: one jitted vmapped searchsorted instead of
+    a host loop (the host path costs ~6s at 1M x 32; this is milliseconds on
+    TPU and keeps the bins matrix on-device for training)."""
+    import jax.numpy as jnp
+    return _get_assign_bins()(jnp.asarray(mapper.upper_bounds),
+                              jnp.asarray(mapper.n_bins),
+                              jnp.asarray(x, jnp.float32))

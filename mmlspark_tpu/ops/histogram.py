@@ -48,19 +48,34 @@ def node_feature_histograms(bins, grad, hess, node_local, active,
     """(n,F) uint8 bins + per-row grad/hess -> three (n_nodes, F, n_bins) f32
     histograms. Rows with active=False contribute nothing."""
     impl = os.environ.get("MMLSPARK_TPU_HIST", "auto")
-    if impl == "pallas" or (impl == "auto" and _should_use_pallas()):
+    use_pallas = (impl == "pallas"
+                  or (impl == "auto" and _should_use_pallas(n_nodes)))
+    if use_pallas:
         try:
             from .histogram_pallas import pallas_hist
         except ImportError as e:
-            raise NotImplementedError(
-                "MMLSPARK_TPU_HIST=pallas requested but the Pallas histogram "
-                "kernel is not available in this build; unset the env var to "
-                "use the XLA scatter path") from e
+            if impl == "pallas":
+                raise NotImplementedError(
+                    "MMLSPARK_TPU_HIST=pallas requested but the Pallas "
+                    "histogram kernel failed to import; unset the env var to "
+                    "use the XLA scatter path") from e
+            use_pallas = False
+    if use_pallas:
         return pallas_hist(bins, grad, hess, node_local, active, n_nodes, n_bins)
     return _xla_hist(bins, grad, hess, node_local, active, n_nodes, n_bins)
 
 
-def _should_use_pallas() -> bool:
-    # flipped on once the Pallas kernel beats the XLA scatter on real TPU
-    # (bench.py compares them); keep XLA as the portable default.
-    return False
+def _should_use_pallas(n_nodes: int) -> bool:
+    """Pallas matmul-histogram on TPU (the XLA scatter is serialized there);
+    the node-onehot trick is VMEM-bounded, so very deep levels fall back."""
+    try:
+        from .histogram_pallas import M_MAX
+    except ImportError:
+        return False
+    if n_nodes > M_MAX:
+        return False
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

@@ -1,0 +1,122 @@
+"""Pallas TPU kernel for GBDT histograms: scatter-add recast as MXU matmuls.
+
+XLA lowers the (node, feature, bin) scatter-add to a serialized scatter —
+~4s/tree at 1M x 32 on v5e. This kernel reformulates it:
+
+    hist[n, f, b] = sum_rows stat[row] * [node(row)==n] * [bin(row,f)==b]
+                  = (node_onehot * stat).T @ bin_onehot_f        per feature
+
+i.e. a (T, 3m).T @ (T, B) matmul per (feature, row-tile) — systolic-array
+work instead of scatter, with both one-hots materialized only in VMEM. All
+three statistics (grad, hess, count) ride one matmul by stacking them into
+the 3m columns.
+
+Layout honors TPU tiling (sublane x lane = 8 x 128): bins arrive transposed
+(F_pad, n) with F padded to a multiple of 8; each grid cell (fb, t) owns an
+(8 features x TILE rows) stripe and its (8, m, B) output block, accumulated
+across row tiles (init at t == 0). Row-aligned stats are (1, n) so the block
+(1, TILE) matches the full sublane dim.
+
+Valid for m = 2^level nodes up to M_MAX (VMEM-bounded 3m matmul columns);
+deeper levels fall back to the XLA scatter path (histogram.py routes).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_ROWS = 4096
+FEATURE_BLOCK = 8
+M_MAX = 64  # max nodes per level handled here (VMEM bound on the 3m columns)
+
+
+def _hist_kernel(bins_ref, node_ref, g_ref, h_ref, hg_ref, hh_ref, hc_ref,
+                 *, m: int, n_bins: int):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        hg_ref[...] = jnp.zeros_like(hg_ref)
+        hh_ref[...] = jnp.zeros_like(hh_ref)
+        hc_ref[...] = jnp.zeros_like(hc_ref)
+
+    node = node_ref[0, :]   # (T,) i32 node id; outside [0, m) = inactive
+    g = g_ref[0, :]
+    h = h_ref[0, :]
+    T = node.shape[0]
+
+    # bf16 one-hots: {0,1} and the stat values round once; the MXU
+    # accumulates in f32 (preferred_element_type), so per-bin sums keep f32
+    # accumulation error. Halves VPU one-hot traffic and doubles MXU rate
+    # vs f32 operands.
+    node_oh = (node[:, None]
+               == jax.lax.broadcasted_iota(jnp.int32, (T, m), 1)
+               ).astype(jnp.float32)
+    # minor-dim broadcasts must stay 32-bit for Mosaic; cast the 2-D products
+    w = jnp.concatenate(
+        [(node_oh * g[:, None]).astype(jnp.bfloat16),
+         (node_oh * h[:, None]).astype(jnp.bfloat16),
+         node_oh.astype(jnp.bfloat16)], axis=1)
+
+    for i in range(FEATURE_BLOCK):  # static unroll over the feature stripe
+        b = bins_ref[i, :]          # (T,) i32
+        bin_oh = (b[:, None]
+                  == jax.lax.broadcasted_iota(jnp.int32, (T, n_bins), 1)
+                  ).astype(jnp.bfloat16)
+        res = jax.lax.dot_general(w, bin_oh, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)  # (3m, B)
+        hg_ref[i] += res[:m]
+        hh_ref[i] += res[m:2 * m]
+        hc_ref[i] += res[2 * m:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nodes", "n_bins", "interpret"))
+def pallas_hist(bins, grad, hess, node_local, active, n_nodes: int,
+                n_bins: int, interpret: bool = False):
+    """Same contract as histogram._xla_hist: (n,F) uint8 bins + per-row stats
+    -> three (n_nodes, F, n_bins) f32 histograms."""
+    n, F = bins.shape
+    # XLA CSE dedupes this transpose across the per-level calls in one tree
+    bins_t = bins.astype(jnp.int32).T  # (F, n)
+    node = jnp.where(active, node_local, -1).astype(jnp.int32)
+
+    pad_f = (-F) % FEATURE_BLOCK
+    pad_n = (-n) % TILE_ROWS
+    if pad_f or pad_n:
+        bins_t = jnp.pad(bins_t, ((0, pad_f), (0, pad_n)))
+        node = jnp.pad(node, (0, pad_n), constant_values=-1)
+        grad = jnp.pad(grad, (0, pad_n))
+        hess = jnp.pad(hess, (0, pad_n))
+    F_pad, n_pad = F + pad_f, n + pad_n
+    nT = n_pad // TILE_ROWS
+    nFB = F_pad // FEATURE_BLOCK
+
+    node2 = node[None, :]
+    g2 = grad.astype(jnp.float32)[None, :]
+    h2 = hess.astype(jnp.float32)[None, :]
+
+    out_shape = [jax.ShapeDtypeStruct((F_pad, n_nodes, n_bins), jnp.float32)] * 3
+    kernel = functools.partial(_hist_kernel, m=n_nodes, n_bins=n_bins)
+    row_spec = pl.BlockSpec((1, TILE_ROWS), lambda fb, t: (0, t))
+    hg, hh, hc = pl.pallas_call(
+        kernel,
+        grid=(nFB, nT),
+        in_specs=[
+            pl.BlockSpec((FEATURE_BLOCK, TILE_ROWS), lambda fb, t: (fb, t)),
+            row_spec, row_spec, row_spec,
+        ],
+        out_specs=[pl.BlockSpec((FEATURE_BLOCK, n_nodes, n_bins),
+                                lambda fb, t: (fb, 0, 0))] * 3,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(bins_t, node2, g2, h2)
+    # (F_pad, m, B) -> (m, F, B)
+    return (hg[:F].transpose(1, 0, 2), hh[:F].transpose(1, 0, 2),
+            hc[:F].transpose(1, 0, 2))
