@@ -1,0 +1,10 @@
+from .value_indexer import ValueIndexer, ValueIndexerModel, IndexToValue
+from .clean_missing import CleanMissingData, CleanMissingDataModel
+from .featurize import (Featurize, FeaturizeModel, CountSelector,
+                        CountSelectorModel, DataConversion)
+from .text import TextFeaturizer, TextFeaturizerModel
+
+__all__ = ["ValueIndexer", "ValueIndexerModel", "IndexToValue",
+           "CleanMissingData", "CleanMissingDataModel", "Featurize",
+           "FeaturizeModel", "CountSelector", "CountSelectorModel",
+           "DataConversion", "TextFeaturizer", "TextFeaturizerModel"]

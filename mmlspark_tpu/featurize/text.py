@@ -1,0 +1,103 @@
+"""TextFeaturizer: tokenize -> n-grams -> hashed TF -> IDF in one estimator
+(reference: featurize/text/TextFeaturizer.scala builds the same SparkML
+pipeline). Hashing uses murmur3 (ops/hashing); TF/IDF vectors are dense f32
+rows sized 2^num_bits, ready for the device.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..core import Estimator, Model, Param, Table, HasInputCol, HasOutputCol
+from ..ops.hashing import hash_token
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def _tokenize(text: str, to_lower=True):
+    s = str(text)
+    if to_lower:
+        s = s.lower()
+    return _TOKEN_RE.findall(s)
+
+
+def _ngrams(tokens, n):
+    if n <= 1:
+        return list(tokens)
+    out = list(tokens)
+    for k in range(2, n + 1):
+        out.extend("_".join(tokens[i:i + k]) for i in range(len(tokens) - k + 1))
+    return out
+
+
+class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
+    use_tokenizer = Param("use_tokenizer", "regex-tokenize input", True)
+    to_lower_case = Param("to_lower_case", "lowercase before tokenizing", True)
+    use_ngram = Param("use_ngram", "add n-grams up to n_gram_length", False)
+    n_gram_length = Param("n_gram_length", "max n-gram size", 2)
+    num_features = Param("num_features", "hash slots (power of two)", 1 << 18)
+    use_idf = Param("use_idf", "apply inverse document frequency", True)
+    min_doc_freq = Param("min_doc_freq", "min docs for a slot to keep idf", 1)
+
+    def _slots(self, texts):
+        bits = int(np.log2(self.num_features))
+        mask = (1 << bits) - 1
+        rows = []
+        for s in texts:
+            toks = _tokenize(s, self.to_lower_case) if self.use_tokenizer else str(s).split()
+            if self.use_ngram:
+                toks = _ngrams(toks, self.n_gram_length)
+            rows.append(np.asarray([hash_token(t) & mask for t in toks], np.int64))
+        return rows
+
+    def _fit(self, t: Table) -> "TextFeaturizerModel":
+        rows = self._slots(t[self.input_col])
+        nf = self.num_features
+        idf = np.ones(nf, np.float32)
+        if self.use_idf:
+            df = np.zeros(nf, np.int64)
+            for r in rows:
+                df[np.unique(r)] += 1
+            n_docs = len(rows)
+            with np.errstate(divide="ignore"):
+                idf = np.log((n_docs + 1.0) / (df + 1.0)).astype(np.float32)
+            idf[df < self.min_doc_freq] = 0.0
+        m = TextFeaturizerModel(**{k: v for k, v in self._paramMap.items()})
+        m._idf = idf
+        return m
+
+
+class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
+    use_tokenizer = Param("use_tokenizer", "regex-tokenize input", True)
+    to_lower_case = Param("to_lower_case", "lowercase before tokenizing", True)
+    use_ngram = Param("use_ngram", "add n-grams up to n_gram_length", False)
+    n_gram_length = Param("n_gram_length", "max n-gram size", 2)
+    num_features = Param("num_features", "hash slots (power of two)", 1 << 18)
+    use_idf = Param("use_idf", "apply inverse document frequency", True)
+    min_doc_freq = Param("min_doc_freq", "min docs for a slot to keep idf", 1)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._idf = None
+
+    def _get_state(self):
+        return {"idf": self._idf}
+
+    def _set_state(self, s):
+        self._idf = np.asarray(s["idf"])
+
+    def _transform(self, t: Table) -> Table:
+        nf = self.num_features
+        bits = int(np.log2(nf))
+        mask = (1 << bits) - 1
+        out = np.zeros((len(t), nf), np.float32)
+        for i, s in enumerate(t[self.input_col]):
+            toks = _tokenize(s, self.to_lower_case) if self.use_tokenizer else str(s).split()
+            if self.use_ngram:
+                toks = _ngrams(toks, self.n_gram_length)
+            for tok in toks:
+                out[i, hash_token(tok) & mask] += 1.0
+        if self.use_idf and self._idf is not None:
+            out *= self._idf[None, :]
+        return t.with_column(self.output_col, out)

@@ -1,0 +1,152 @@
+"""Linear learners (logistic / linear regression) as jitted full-batch optax
+runs — the stand-ins for the SparkML learners the reference's AutoTrain and
+AutoML wrap (TrainClassifier's default learner is logistic regression,
+train/TrainClassifier.scala:49).
+
+One fused lax.scan of optimizer steps per fit: no host loop, TPU-friendly.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core import (Estimator, Model, Param, Table, HasFeaturesCol, HasLabelCol,
+                    HasPredictionCol, HasProbabilitiesCol, HasWeightCol)
+
+
+@functools.partial(jax.jit, static_argnames=("n_steps", "n_classes", "kind"))
+def _fit_linear(x, y, w, n_steps: int, n_classes: int, kind: str,
+                reg_l2: float, lr: float):
+    n, f = x.shape
+    out_dim = n_classes if kind == "multiclass" else 1
+    params = {"w": jnp.zeros((f, out_dim), jnp.float32),
+              "b": jnp.zeros((out_dim,), jnp.float32)}
+    opt = optax.adam(lr)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        logits = x @ p["w"] + p["b"]
+        if kind == "binary":
+            ll = optax.sigmoid_binary_cross_entropy(logits[:, 0], y)
+        elif kind == "multiclass":
+            ll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y.astype(jnp.int32))
+        else:
+            ll = 0.5 * (logits[:, 0] - y) ** 2
+        reg = reg_l2 * sum(jnp.sum(v ** 2) for v in jax.tree_util.tree_leaves(p))
+        return jnp.sum(ll * w) / jnp.sum(w) + reg
+
+    def step(carry, _):
+        p, s = carry
+        g = jax.grad(loss_fn)(p)
+        updates, s = opt.update(g, s, p)
+        return (optax.apply_updates(p, updates), s), None
+
+    (params, _), _ = jax.lax.scan(step, (params, state), None, length=n_steps)
+    return params
+
+
+class _LinearBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol,
+                  HasPredictionCol):
+    max_iter = Param("max_iter", "optimizer steps", 300)
+    reg_param = Param("reg_param", "L2 regularization", 0.0)
+    learning_rate = Param("learning_rate", "adam step size", 0.1)
+
+    def _data(self, t: Table):
+        x = jnp.asarray(np.asarray(t[self.features_col], np.float32))
+        y = jnp.asarray(np.asarray(t[self.label_col], np.float32))
+        if self.weight_col and self.weight_col in t:
+            w = jnp.asarray(np.asarray(t[self.weight_col], np.float32))
+        else:
+            w = jnp.ones(x.shape[0], jnp.float32)
+        return x, y, w
+
+
+class LogisticRegression(_LinearBase, HasProbabilitiesCol):
+    num_classes = Param("num_classes", "0 = infer from labels", 0)
+
+    def _fit(self, t: Table) -> "LogisticRegressionModel":
+        x, y, w = self._data(t)
+        k = self.num_classes or int(np.asarray(y).max()) + 1
+        kind = "binary" if k <= 2 else "multiclass"
+        params = _fit_linear(x, y, w, self.max_iter, k, kind,
+                             self.reg_param, self.learning_rate)
+        m = LogisticRegressionModel(
+            features_col=self.features_col, prediction_col=self.prediction_col,
+            probabilities_col=self.probabilities_col, n_classes=k)
+        m._w = np.asarray(params["w"])
+        m._b = np.asarray(params["b"])
+        return m
+
+
+class LogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol,
+                              HasProbabilitiesCol):
+    n_classes = Param("n_classes", "number of classes", 2)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._w = self._b = None
+
+    def _get_state(self):
+        return {"w": self._w, "b": self._b}
+
+    def _set_state(self, s):
+        self._w, self._b = np.asarray(s["w"]), np.asarray(s["b"])
+
+    def _transform(self, t: Table) -> Table:
+        x = np.asarray(t[self.features_col], np.float32)
+        logits = x @ self._w + self._b
+        if self.n_classes <= 2:
+            p1 = 1.0 / (1.0 + np.exp(-logits[:, 0]))
+            proba = np.stack([1 - p1, p1], axis=1)
+        else:
+            e = np.exp(logits - logits.max(1, keepdims=True))
+            proba = e / e.sum(1, keepdims=True)
+        return (t.with_column(self.probabilities_col, proba)
+                 .with_column(self.prediction_col,
+                              proba.argmax(1).astype(np.float64)))
+
+
+class LinearRegression(_LinearBase):
+    solver = Param("solver", "normal|sgd", "normal")
+
+    def _fit(self, t: Table) -> "LinearRegressionModel":
+        x, y, w = self._data(t)
+        m = LinearRegressionModel(features_col=self.features_col,
+                                  prediction_col=self.prediction_col)
+        if self.solver == "normal":
+            xn = np.asarray(x, np.float64)
+            yn = np.asarray(y, np.float64)
+            wn = np.asarray(w, np.float64)
+            xa = np.concatenate([xn, np.ones((len(xn), 1))], axis=1)
+            xtw = xa.T * wn
+            A = xtw @ xa + self.reg_param * np.eye(xa.shape[1])
+            beta = np.linalg.solve(A, xtw @ yn)
+            m._w, m._b = beta[:-1].astype(np.float32), np.float32(beta[-1])
+        else:
+            params = _fit_linear(x, y, w, self.max_iter, 1, "regression",
+                                 self.reg_param, self.learning_rate)
+            m._w = np.asarray(params["w"])[:, 0]
+            m._b = np.float32(np.asarray(params["b"])[0])
+        return m
+
+
+class LinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._w = self._b = None
+
+    def _get_state(self):
+        return {"w": self._w, "b": np.asarray(self._b)}
+
+    def _set_state(self, s):
+        self._w, self._b = np.asarray(s["w"]), np.float32(np.asarray(s["b"]))
+
+    def _transform(self, t: Table) -> Table:
+        x = np.asarray(t[self.features_col], np.float32)
+        return t.with_column(self.prediction_col,
+                             (x @ self._w + self._b).astype(np.float64))

@@ -1,0 +1,12 @@
+from .compute_model_statistics import (ComputeModelStatistics,
+                                       ComputePerInstanceStatistics,
+                                       ClassificationEvaluator,
+                                       RegressionEvaluator)
+from .train_classifier import (TrainClassifier, TrainedClassifierModel,
+                               TrainRegressor, TrainedRegressorModel)
+from . import metrics
+
+__all__ = ["ComputeModelStatistics", "ComputePerInstanceStatistics",
+           "ClassificationEvaluator", "RegressionEvaluator", "TrainClassifier",
+           "TrainedClassifierModel", "TrainRegressor", "TrainedRegressorModel",
+           "metrics"]

@@ -1,0 +1,145 @@
+"""Metric computation core (reference: core/metrics/MetricConstants.scala,
+train/ComputeModelStatistics.scala:58-470). Vectorized numpy/JAX over whole
+columns — the reference's RDD MulticlassMetrics/BinaryClassificationMetrics
+become closed-form array ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# reference: MetricConstants.scala names
+CLASSIFICATION_METRICS = ["accuracy", "precision", "recall", "AUC"]
+REGRESSION_METRICS = ["mse", "rmse", "r2", "mae"]
+
+
+def confusion_matrix(y_true, y_pred, n_classes=None):
+    y_true = np.asarray(y_true).astype(int)
+    y_pred = np.asarray(y_pred).astype(int)
+    k = n_classes or int(max(y_true.max(), y_pred.max())) + 1
+    cm = np.zeros((k, k), dtype=np.int64)
+    np.add.at(cm, (y_true, y_pred), 1)
+    return cm
+
+
+def auc(y_true, scores):
+    """Rank-statistic AUC (Mann-Whitney), ties averaged."""
+    y_true = np.asarray(y_true)
+    scores = np.asarray(scores, dtype=np.float64)
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty(len(scores), dtype=np.float64)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    # average ranks for ties
+    uniq, inv, counts = np.unique(scores, return_inverse=True, return_counts=True)
+    cum = np.cumsum(counts)
+    avg_rank = cum - (counts - 1) / 2.0
+    ranks = avg_rank[inv]
+    npos = float(y_true.sum())
+    nneg = float(len(y_true) - npos)
+    if npos == 0 or nneg == 0:
+        return 0.5
+    return float((ranks[y_true == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg))
+
+
+def pr_auc(y_true, scores):
+    """Area under precision-recall curve (AUPR)."""
+    y_true = np.asarray(y_true)
+    scores = np.asarray(scores, dtype=np.float64)
+    order = np.argsort(-scores, kind="stable")
+    y = y_true[order]
+    s = scores[order]
+    tp = np.cumsum(y)
+    fp = np.cumsum(1 - y)
+    npos = y.sum()
+    if npos == 0:
+        return 0.0
+    # evaluate only at distinct-threshold boundaries (tie groups collapse),
+    # matching sklearn's average_precision_score convention
+    distinct = np.r_[s[1:] != s[:-1], True]
+    tp, fp = tp[distinct], fp[distinct]
+    precision = tp / np.maximum(tp + fp, 1)
+    recall = tp / npos
+    d_recall = np.diff(np.concatenate([[0.0], recall]))
+    return float((precision * d_recall).sum())
+
+
+def binary_metrics(y_true, scores, y_pred=None, threshold=0.5):
+    y_true = np.asarray(y_true)
+    scores = np.asarray(scores)
+    if y_pred is None:
+        y_pred = (scores >= threshold).astype(float)
+    cm = confusion_matrix(y_true, y_pred, 2)
+    tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
+    out = {
+        "accuracy": (tp + tn) / max(cm.sum(), 1),
+        "precision": tp / max(tp + fp, 1),
+        "recall": tp / max(tp + fn, 1),
+        "AUC": auc(y_true, scores),
+        "AUPR": pr_auc(y_true, scores),
+    }
+    out["f1"] = (2 * out["precision"] * out["recall"]
+                 / max(out["precision"] + out["recall"], 1e-12))
+    return out, cm
+
+
+def multiclass_metrics(y_true, y_pred, n_classes=None):
+    """Macro/micro averaged metrics from the paper formulas the reference
+    cites (ComputeModelStatistics.scala:330-436)."""
+    cm = confusion_matrix(y_true, y_pred, n_classes)
+    k = cm.shape[0]
+    tp = np.diag(cm).astype(np.float64)
+    fp = cm.sum(axis=0) - tp
+    fn = cm.sum(axis=1) - tp
+    total = cm.sum()
+    per_class_precision = tp / np.maximum(tp + fp, 1)
+    per_class_recall = tp / np.maximum(tp + fn, 1)
+    micro_p = tp.sum() / max((tp + fp).sum(), 1)
+    micro_r = tp.sum() / max((tp + fn).sum(), 1)
+    out = {
+        "accuracy": tp.sum() / max(total, 1),
+        "precision": micro_p,        # micro (reference default)
+        "recall": micro_r,
+        "macro_precision": per_class_precision.mean(),
+        "macro_recall": per_class_recall.mean(),
+        "AUC": float("nan"),
+    }
+    return out, cm
+
+
+def regression_metrics(y_true, y_pred):
+    y_true = np.asarray(y_true, dtype=np.float64)
+    y_pred = np.asarray(y_pred, dtype=np.float64)
+    resid = y_true - y_pred
+    mse = float((resid ** 2).mean())
+    var = float(((y_true - y_true.mean()) ** 2).mean())
+    return {
+        "mse": mse,
+        "rmse": float(np.sqrt(mse)),
+        "r2": 1.0 - mse / max(var, 1e-300),
+        "mae": float(np.abs(resid).mean()),
+    }
+
+
+def per_instance_classification(y_true, probabilities):
+    """Per-row log-loss (reference: ComputePerInstanceStatistics)."""
+    probabilities = np.asarray(probabilities)
+    y = np.asarray(y_true).astype(int)
+    p = np.clip(probabilities[np.arange(len(y)), y], 1e-15, 1.0)
+    return {"log_loss": -np.log(p)}
+
+
+def per_instance_regression(y_true, y_pred):
+    resid = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    return {"L1_loss": np.abs(resid), "L2_loss": resid ** 2}
+
+
+def ndcg_at_k(labels_by_group, scores_by_group, k=10):
+    """Mean NDCG@k over query groups (for the ranking evaluator)."""
+    vals = []
+    for lab, sc in zip(labels_by_group, scores_by_group):
+        lab = np.asarray(lab, dtype=np.float64)
+        order = np.argsort(-np.asarray(sc))[:k]
+        gains = (2 ** lab[order] - 1) / np.log2(np.arange(2, len(order) + 2))
+        ideal_order = np.argsort(-lab)[:k]
+        ideal = (2 ** lab[ideal_order] - 1) / np.log2(np.arange(2, len(ideal_order) + 2))
+        vals.append(gains.sum() / max(ideal.sum(), 1e-12))
+    return float(np.mean(vals)) if vals else 0.0

@@ -1,0 +1,150 @@
+"""TrainClassifier / TrainRegressor: wrap any learner with auto-featurization
+and label indexing (reference: train/TrainClassifier.scala:49-377,
+train/TrainRegressor.scala). The fitted model is featurize -> inner model ->
+un-index labels, exactly the reference's TrainedClassifierModel composition.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import (Estimator, Model, Param, Table, HasLabelCol,
+                    HasFeaturesCol)
+from ..featurize.featurize import Featurize
+from ..featurize.value_indexer import ValueIndexer
+
+
+class TrainClassifier(Estimator, HasLabelCol):
+    model = Param("model", "inner classifier estimator", None)
+    features_col = Param("features_col", "assembled features column",
+                         "__train_features")
+    num_features = Param("num_features", "hash-slot override for featurize", 0)
+    reindex_label = Param("reindex_label", "index non-contiguous labels", True)
+
+    def _fit(self, t: Table) -> "TrainedClassifierModel":
+        inner = self.model
+        if inner is None:
+            from ..models.linear import LogisticRegression
+            inner = LogisticRegression()
+        # label indexing (TrainClassifier.scala:91-160)
+        label_model = None
+        y = t[self.label_col]
+        work = t
+        if self.reindex_label:
+            needs = (y.dtype == object
+                     or not np.issubdtype(y.dtype, np.number)
+                     or (np.unique(y) != np.arange(len(np.unique(y)))).any())
+            if needs:
+                label_model = ValueIndexer(
+                    input_col=self.label_col,
+                    output_col="__label_idx").fit(t)
+                work = label_model.transform(t)
+                work = work.drop(self.label_col).rename(
+                    {"__label_idx": self.label_col})
+        feat = Featurize(output_col=self.features_col,
+                         label_col=self.label_col,
+                         num_features=self.num_features).fit(work)
+        featurized = feat.transform(work)
+        inner = inner.copy({"features_col": self.features_col,
+                            "label_col": self.label_col})
+        fitted = inner.fit(featurized)
+        m = TrainedClassifierModel(label_col=self.label_col)
+        m._featurizer, m._model, m._label_model = feat, fitted, label_model
+        return m
+
+
+class TrainedClassifierModel(Model, HasLabelCol):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._featurizer = self._model = self._label_model = None
+
+    def _get_state(self):
+        # nested stages persist through the stage-list param mechanism
+        return {}
+
+    @property
+    def inner_model(self):
+        return self._model
+
+    def save(self, path):
+        self.set(stages=[s for s in [self._featurizer, self._model,
+                                     self._label_model] if s is not None])
+        super().save(path)
+
+    stages = Param("stages", "nested fitted stages (persistence only)", None)
+
+    @classmethod
+    def load(cls, path):
+        from ..core import serialize
+        m = serialize.load_stage(path)
+        stages = m.get("stages") or []
+        m._featurizer = stages[0] if len(stages) > 0 else None
+        m._model = stages[1] if len(stages) > 1 else None
+        m._label_model = stages[2] if len(stages) > 2 else None
+        return m
+
+    def _transform(self, t: Table) -> Table:
+        out = self._featurizer.transform(t)
+        out = self._model.transform(out)
+        if self._label_model is not None:
+            # un-index predicted labels back to the original values
+            levels = self._label_model._levels
+            pred = np.asarray(out["prediction"]).astype(int)
+            out = out.with_column("scored_labels",
+                                  levels[np.clip(pred, 0, len(levels) - 1)])
+        else:
+            out = out.with_column("scored_labels", out["prediction"])
+        return out.drop(self._featurizer.output_col)
+
+
+class TrainRegressor(Estimator, HasLabelCol):
+    model = Param("model", "inner regressor estimator", None)
+    features_col = Param("features_col", "assembled features column",
+                         "__train_features")
+    num_features = Param("num_features", "hash-slot override for featurize", 0)
+
+    def _fit(self, t: Table) -> "TrainedRegressorModel":
+        inner = self.model
+        if inner is None:
+            from ..models.linear import LinearRegression
+            inner = LinearRegression()
+        feat = Featurize(output_col=self.features_col,
+                         label_col=self.label_col,
+                         num_features=self.num_features).fit(t)
+        featurized = feat.transform(t)
+        inner = inner.copy({"features_col": self.features_col,
+                            "label_col": self.label_col})
+        fitted = inner.fit(featurized)
+        m = TrainedRegressorModel(label_col=self.label_col)
+        m._featurizer, m._model = feat, fitted
+        return m
+
+
+class TrainedRegressorModel(Model, HasLabelCol):
+    stages = Param("stages", "nested fitted stages (persistence only)", None)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._featurizer = self._model = None
+
+    def save(self, path):
+        self.set(stages=[self._featurizer, self._model])
+        super().save(path)
+
+    @classmethod
+    def load(cls, path):
+        from ..core import serialize
+        m = serialize.load_stage(path)
+        stages = m.get("stages") or []
+        m._featurizer = stages[0] if len(stages) > 0 else None
+        m._model = stages[1] if len(stages) > 1 else None
+        return m
+
+    @property
+    def inner_model(self):
+        return self._model
+
+    def _transform(self, t: Table) -> Table:
+        out = self._featurizer.transform(t)
+        out = self._model.transform(out)
+        return (out.with_column("scored_labels", out["prediction"])
+                   .drop(self._featurizer.output_col))

@@ -1,0 +1,186 @@
+"""Featurize / TrainClassifier / ComputeModelStatistics / AutoML suites
+(mirrors reference VerifyFeaturize, VerifyTrainClassifier,
+VerifyComputeModelStatistics, VerifyTuneHyperparameters, VerifyFindBestModel)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.featurize import (CleanMissingData, CountSelector, Featurize,
+                                    TextFeaturizer, ValueIndexer)
+from mmlspark_tpu.models.gbdt import GBDTClassifier
+from mmlspark_tpu.models.linear import (LinearRegression, LogisticRegression)
+from mmlspark_tpu.train import (ClassificationEvaluator, ComputeModelStatistics,
+                                ComputePerInstanceStatistics, TrainClassifier,
+                                TrainRegressor, metrics)
+from mmlspark_tpu.automl import (DiscreteHyperParam, FindBestModel,
+                                 HyperparamBuilder, RangeHyperParam,
+                                 TuneHyperparameters)
+
+from benchmarks import Benchmarks
+from fuzzing import assert_tables_equal, fuzz_estimator, roundtrip
+
+
+@pytest.fixture(scope="module")
+def mixed_table():
+    rng = np.random.default_rng(0)
+    n = 400
+    num = rng.normal(size=n).astype(np.float32)
+    num[::17] = np.nan
+    cat = rng.choice(["red", "green", "blue"], size=n)
+    big = rng.normal(size=(n, 3)).astype(np.float32)
+    y = ((num > 0).astype(float) + (cat == "red")) % 2
+    return Table({"x1": num, "color": cat, "vec": big, "label": y.astype(np.float32)})
+
+
+# ------------------------------------------------------------- metrics
+def test_binary_metrics_against_sklearn():
+    from sklearn.metrics import roc_auc_score, average_precision_score
+    rng = np.random.default_rng(1)
+    y = (rng.uniform(size=500) > 0.5).astype(float)
+    s = np.clip(y * 0.6 + rng.normal(scale=0.3, size=500), 0, 1)
+    vals, cm = metrics.binary_metrics(y, s)
+    assert abs(vals["AUC"] - roc_auc_score(y, s)) < 1e-9
+    assert abs(vals["AUPR"] - average_precision_score(y, s)) < 1e-6
+    assert cm.sum() == 500
+
+
+def test_regression_metrics():
+    y = np.asarray([1.0, 2.0, 3.0])
+    p = np.asarray([1.5, 2.0, 2.5])
+    vals = metrics.regression_metrics(y, p)
+    assert abs(vals["mse"] - (0.25 + 0 + 0.25) / 3) < 1e-12
+    assert vals["r2"] < 1.0
+
+
+def test_compute_model_statistics_classification(mixed_table):
+    m = GBDTClassifier(num_iterations=10, min_data_in_leaf=5)
+    tc = TrainClassifier(model=m).fit(mixed_table)
+    scored = tc.transform(mixed_table)
+    stats = ComputeModelStatistics().transform(scored)
+    assert stats["accuracy"][0] > 0.9
+    assert stats["AUC"][0] > 0.9
+    per = ComputePerInstanceStatistics().transform(scored)
+    assert "log_loss" in per.columns
+
+
+def test_compute_model_statistics_regression():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (x @ [1, 2, -1, 0.5]).astype(np.float32)
+    t = Table({"features": x, "label": y})
+    m = LinearRegression().fit(t)
+    stats = ComputeModelStatistics(evaluation_metric="regression").transform(
+        m.transform(t))
+    assert stats["r2"][0] > 0.99
+
+
+# ------------------------------------------------------------- featurize
+def test_value_indexer_roundtrip(mixed_table):
+    vi = ValueIndexer(input_col="color", output_col="idx")
+    model, out = fuzz_estimator(vi, mixed_table)
+    assert set(np.unique(out["idx"])) <= {0, 1, 2}
+    # unseen value maps to -1
+    t2 = Table({"color": np.asarray(["purple", "red"], dtype=object)})
+    assert model.transform(t2)["idx"][0] == -1
+
+
+def test_clean_missing(mixed_table):
+    model, out = fuzz_estimator(CleanMissingData(input_cols=["x1"]), mixed_table)
+    assert not np.isnan(out["x1"]).any()
+
+
+def test_featurize_mixed(mixed_table):
+    model, out = fuzz_estimator(Featurize(label_col="label"), mixed_table)
+    f = out["features"]
+    # 1 numeric + 3 one-hot + 3 vector = 7 columns
+    assert f.shape == (len(mixed_table), 7)
+    assert not np.isnan(f).any()
+
+
+def test_featurize_hashing_high_cardinality():
+    rng = np.random.default_rng(3)
+    ids = np.asarray([f"user_{i}" for i in rng.integers(0, 500, size=300)])
+    t = Table({"uid": ids, "label": rng.uniform(size=300).astype(np.float32)})
+    m = Featurize(label_col="label", num_features=256).fit(t)
+    f = m.transform(t)["features"]
+    assert f.shape[1] == 256
+    assert (f.sum(axis=1) == 1).all()
+
+
+def test_count_selector():
+    x = np.zeros((10, 5), np.float32)
+    x[:, 1] = 1.0
+    x[:, 3] = 2.0
+    t = Table({"features": x})
+    model, out = fuzz_estimator(CountSelector(), t)
+    assert out["features"].shape == (10, 2)
+
+
+def test_text_featurizer():
+    docs = np.asarray(["the cat sat on the mat", "the dog ate my homework",
+                       "cats and dogs", "homework is due"], dtype=object)
+    t = Table({"text": docs, "label": np.asarray([0, 1, 0, 1], np.float32)})
+    tf = TextFeaturizer(input_col="text", output_col="tf", num_features=1 << 10)
+    model, out = fuzz_estimator(tf, t)
+    assert out["tf"].shape == (4, 1024)
+    assert (out["tf"] >= 0).all() and out["tf"].sum() > 0
+
+
+# ------------------------------------------------------------- auto-train
+BENCH = Benchmarks("VerifyTrainClassifier")
+
+
+def test_train_classifier_string_labels(mixed_table):
+    t = mixed_table.with_column(
+        "label", np.where(np.asarray(mixed_table["label"]) > 0, "yes", "no"))
+    tc = TrainClassifier(model=LogisticRegression(max_iter=200))
+    model = tc.fit(t)
+    out = model.transform(t)
+    assert set(np.unique(out["scored_labels"])) <= {"yes", "no"}
+    acc = (out["scored_labels"] == t["label"]).mean()
+    assert acc > 0.85
+    BENCH.add("logreg_mixed_accuracy", float(acc), 0.05)
+    BENCH.flush()
+
+
+def test_train_regressor():
+    rng = np.random.default_rng(4)
+    n = 300
+    t = Table({"a": rng.normal(size=n).astype(np.float32),
+               "b": rng.choice(["u", "v"], size=n),
+               "label": rng.normal(size=n).astype(np.float32)})
+    y = np.asarray(t["a"]) * 2 + (np.asarray(t["b"]) == "u") * 3
+    t = t.with_column("label", y.astype(np.float32))
+    model = TrainRegressor(model=LinearRegression()).fit(t)
+    pred = model.transform(t)["prediction"]
+    assert metrics.regression_metrics(y, pred)["r2"] > 0.99
+
+
+# ------------------------------------------------------------- automl
+def test_tune_hyperparameters(mixed_table):
+    space = (HyperparamBuilder()
+             .add_hyperparam("num_iterations", DiscreteHyperParam([5, 10]))
+             .add_hyperparam("learning_rate", RangeHyperParam(0.05, 0.3))
+             .build())
+    feat = Featurize(label_col="label").fit(mixed_table)
+    ft = feat.transform(mixed_table)
+    tuner = TuneHyperparameters(
+        models=[GBDTClassifier(min_data_in_leaf=5)], hyperparam_space=space,
+        evaluation_metric="AUC", number_of_folds=2, parallelism=2,
+        number_of_iterations=3, seed=1)
+    model = tuner.fit(ft)
+    assert model.best_metric > 0.8
+    assert "num_iterations" in model.get_best_model_info()
+    out = model.transform(ft)
+    assert "prediction" in out.columns
+
+
+def test_find_best_model(mixed_table):
+    feat = Featurize(label_col="label").fit(mixed_table)
+    ft = feat.transform(mixed_table)
+    models = [GBDTClassifier(num_iterations=k, min_data_in_leaf=5).fit(ft)
+              for k in (2, 15)]
+    bm = FindBestModel(models=models, evaluation_metric="AUC").fit(ft)
+    assert bm.best_model is models[1]  # more trees wins on train eval
+    res = bm.get_evaluation_results()
+    assert len(res) == 2
