@@ -55,16 +55,11 @@ class BestModel(Model):
         return Table({"model": np.arange(len(self._scores)),
                       self._metric or "metric": np.asarray(self._scores)})
 
-    def save(self, path):
+    def _prepare_save(self):
         self.set(best_model_stage=self._best_model)
-        super().save(path)
 
-    @classmethod
-    def load(cls, path):
-        from ..core import serialize
-        m = serialize.load_stage(path)
-        m._best_model = m.get("best_model_stage")
-        return m
+    def _finish_load(self):
+        self._best_model = self.get("best_model_stage")
 
     def _transform(self, t: Table) -> Table:
         return self._best_model.transform(t)

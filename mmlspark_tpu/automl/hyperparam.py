@@ -7,7 +7,8 @@ import numpy as np
 
 class DiscreteHyperParam:
     def __init__(self, values):
-        self.values = list(values)
+        # unwrap numpy scalars so the grid JSON-serializes
+        self.values = [v.item() if hasattr(v, "item") else v for v in values]
 
     def sample(self, rng):
         return self.values[rng.integers(0, len(self.values))]
@@ -15,10 +16,44 @@ class DiscreteHyperParam:
     def grid(self):
         return list(self.values)
 
+    def _to_json(self):
+        return {"values": self.values}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["values"])
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other.values == self.values
+
+    def __hash__(self):
+        try:
+            # hash(1) == hash(1.0) keeps this consistent with list __eq__
+            return hash((type(self).__name__, tuple(self.values)))
+        except TypeError:  # unhashable members
+            return hash((type(self).__name__, len(self.values)))
+
 
 class RangeHyperParam:
     def __init__(self, lo, hi, is_int=False, log=False):
         self.lo, self.hi, self.is_int, self.log = lo, hi, is_int, log
+
+    def _to_json(self):
+        return {"lo": self.lo, "hi": self.hi, "is_int": self.is_int,
+                "log": self.log}
+
+    @classmethod
+    def _from_json(cls, d):
+        return cls(d["lo"], d["hi"], d["is_int"], d["log"])
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and (other.lo, other.hi, other.is_int, other.log)
+                == (self.lo, self.hi, self.is_int, self.log))
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.lo, self.hi, self.is_int,
+                     self.log))
 
     def sample(self, rng):
         if self.log:

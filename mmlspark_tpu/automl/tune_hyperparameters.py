@@ -112,16 +112,11 @@ class TuneHyperparametersModel(Model):
     def get_best_model_info(self) -> str:
         return f"params={self._best_params} metric={self._best_metric}"
 
-    def save(self, path):
+    def _prepare_save(self):
         self.set(best_model_stage=self._best_model)
-        super().save(path)
 
-    @classmethod
-    def load(cls, path):
-        from ..core import serialize
-        m = serialize.load_stage(path)
-        m._best_model = m.get("best_model_stage")
-        return m
+    def _finish_load(self):
+        self._best_model = self.get("best_model_stage")
 
     def _transform(self, t: Table) -> Table:
         return self._best_model.transform(t)

@@ -23,15 +23,19 @@ class Param:
     dispatched on runtime type in `mmlspark_tpu.core.serialize`.
     """
 
-    __slots__ = ("name", "doc", "default", "validator", "owner")
+    __slots__ = ("name", "doc", "default", "validator", "owner", "transient")
 
     def __init__(self, name: str, doc: str = "", default: Any = None,
-                 validator: Optional[Callable[[Any], bool]] = None):
+                 validator: Optional[Callable[[Any], bool]] = None,
+                 transient: bool = False):
         self.name = name
         self.doc = doc
         self.default = default
         self.validator = validator
         self.owner = None  # set by Params.__init_subclass__
+        # transient params (callables, live handles) are skipped by save();
+        # a loaded stage reverts them to their default
+        self.transient = transient
 
     def validate(self, value: Any) -> None:
         if self.validator is not None and value is not None:

@@ -59,6 +59,14 @@ class PipelineStage(Params):
     def _set_state(self, state: dict) -> None:
         pass
 
+    def _prepare_save(self) -> None:
+        """Called by serialize.save_stage before params are read — models
+        holding fitted sub-stages in private attrs stash them into Params
+        here. Runs for nested stages too (unlike an overridden save())."""
+
+    def _finish_load(self) -> None:
+        """Called by serialize.load_stage after params/state are restored."""
+
     def save(self, path: str) -> None:
         from . import serialize
         serialize.save_stage(self, path)

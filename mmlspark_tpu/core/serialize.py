@@ -26,7 +26,102 @@ def _is_stage(v) -> bool:
     return isinstance(v, PipelineStage)
 
 
+def _json_roundtrips(value) -> bool:
+    """True only if JSON round-trips the value IDENTICALLY — rejects any
+    nested dict with non-string keys (json.dumps would stringify them and
+    load would silently return different key types)."""
+    if isinstance(value, dict):
+        return all(isinstance(k, str) for k in value) and all(
+            _json_roundtrips(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return all(_json_roundtrips(v) for v in value)
+    return isinstance(value, (str, int, float, bool)) or value is None
+
+
+def _encode_value(value, slot: str, path: str, arrays: dict) -> dict:
+    """Recursive kind-tagged encoding of one param value. `slot` uniquely
+    names any array refs / stage subdirs this value needs."""
+    if value is None:
+        return {"kind": "json", "value": None}
+    if _is_stage(value):
+        sub = os.path.join(path, "stages", slot)
+        save_stage(value, sub)
+        return {"kind": "stage", "ref": f"stages/{slot}"}
+    if isinstance(value, (list, tuple)) and value and all(_is_stage(v) for v in value):
+        refs = []
+        for i, v in enumerate(value):
+            save_stage(v, os.path.join(path, "stages", f"{slot}_{i}"))
+            refs.append(f"stages/{slot}_{i}")
+        return {"kind": "stage_list", "refs": refs}
+    if isinstance(value, np.ndarray):
+        if value.dtype == object:
+            # np.savez would pickle these and load (allow_pickle=False)
+            # would then fail — encode as a JSON list instead.
+            return {"kind": "object_array", "value": value.tolist()}
+        arrays[slot] = value
+        return {"kind": "array", "ref": slot}
+    if hasattr(value, "_to_json") and hasattr(type(value), "_from_json"):
+        # custom codec hook (hyperparam distributions, parsers, ...);
+        # validate the payload NOW so a bad _to_json (e.g. np.int64 leaves)
+        # fails with the param-level diagnostic before any files are written
+        payload = value._to_json()
+        json.dumps(payload)
+        return {"kind": "custom",
+                "class": f"{type(value).__module__}.{type(value).__name__}",
+                "value": payload}
+    if isinstance(value, dict):
+        for k in value:
+            # scalar keys only: JSON object keys stringify ints/bools and
+            # tuple keys would json-encode to (unhashable) lists — reject at
+            # save time rather than corrupting the artifact
+            if not isinstance(k, (str, int, float, bool)) and k is not None:
+                raise TypeError(f"dict param key {k!r} is not a scalar")
+        if _json_roundtrips(value):
+            return {"kind": "json", "value": value}
+        # keys JSON-encoded separately so int/bool keys keep their type
+        return {"kind": "dict",
+                "items": [[json.dumps(k),
+                           _encode_value(v, f"{slot}__{i}", path, arrays)]
+                          for i, (k, v) in enumerate(value.items())]}
+    if isinstance(value, (list, tuple)):
+        if _json_roundtrips(list(value)):
+            return {"kind": "json", "value": list(value)}
+        return {"kind": "list",
+                "items": [_encode_value(v, f"{slot}__{i}", path, arrays)
+                          for i, v in enumerate(value)]}
+    if isinstance(value, (np.integer, np.floating, np.bool_)):
+        return {"kind": "json", "value": value.item()}
+    json.dumps(value)  # raises TypeError for anything we can't persist
+    return {"kind": "json", "value": value}
+
+
+def _decode_value(spec: dict, path: str, arrays: dict):
+    kind = spec["kind"]
+    if kind == "json":
+        return spec["value"]
+    if kind == "object_array":
+        return np.asarray(spec["value"], dtype=object)
+    if kind == "array":
+        return arrays[spec["ref"]]
+    if kind == "stage":
+        return load_stage(os.path.join(path, spec["ref"]))
+    if kind == "stage_list":
+        return [load_stage(os.path.join(path, r)) for r in spec["refs"]]
+    if kind == "custom":
+        import importlib
+        mod, _, cname = spec["class"].rpartition(".")
+        cls = getattr(importlib.import_module(mod), cname)
+        return cls._from_json(spec["value"])
+    if kind == "dict":
+        return {json.loads(k): _decode_value(v, path, arrays)
+                for k, v in spec["items"]}
+    if kind == "list":
+        return [_decode_value(v, path, arrays) for v in spec["items"]]
+    raise ValueError(f"unknown param kind {kind!r}")
+
+
 def save_stage(stage, path: str) -> None:
+    stage._prepare_save()
     os.makedirs(path, exist_ok=True)
     meta: dict[str, Any] = {
         "class": f"{type(stage).__module__}.{type(stage).__name__}",
@@ -36,38 +131,23 @@ def save_stage(stage, path: str) -> None:
     }
     arrays: dict[str, np.ndarray] = {}
 
+    transient = []
     for name, value in stage._paramMap.items():
-        if value is None:
-            meta["params"][name] = {"kind": "json", "value": None}
-        elif _is_stage(value):
-            sub = os.path.join(path, "stages", f"p_{name}")
-            save_stage(value, sub)
-            meta["params"][name] = {"kind": "stage", "ref": f"stages/p_{name}"}
-        elif isinstance(value, (list, tuple)) and value and all(_is_stage(v) for v in value):
-            refs = []
-            for i, v in enumerate(value):
-                sub = os.path.join(path, "stages", f"{name}_{i}")
-                save_stage(v, sub)
-                refs.append(f"stages/{name}_{i}")
-            meta["params"][name] = {"kind": "stage_list", "refs": refs}
-        elif isinstance(value, np.ndarray):
-            if value.dtype == object:
-                # np.savez would pickle these and load (allow_pickle=False)
-                # would then fail — encode as a JSON list instead.
-                meta["params"][name] = {"kind": "object_array",
-                                        "value": value.tolist()}
-            else:
-                arrays[f"param__{name}"] = value
-                meta["params"][name] = {"kind": "array", "ref": f"param__{name}"}
-        else:
-            try:
-                json.dumps(value)
-                meta["params"][name] = {"kind": "json", "value": value}
-            except TypeError:
-                raise TypeError(
-                    f"param {name!r} of {type(stage).__name__} holds "
-                    f"non-serializable value {type(value).__name__}; "
-                    f"mark it transient or provide an array/stage value")
+        p = stage._param_registry.get(name)
+        if p is not None and p.transient:
+            transient.append(name)  # recorded, not persisted (e.g. fobj)
+            continue
+        try:
+            meta["params"][name] = _encode_value(value, f"param__{name}",
+                                                 path, arrays)
+        except TypeError as e:
+            raise TypeError(
+                f"param {name!r} of {type(stage).__name__} is not "
+                f"serializable ({e}); mark it transient "
+                f"(Param(..., transient=True)) or provide an array/stage "
+                f"value") from e
+    if transient:
+        meta["transient_params"] = transient
 
     state = stage._get_state()
     json_state, state_keys = {}, []
@@ -115,21 +195,8 @@ def load_stage(path: str):
         with np.load(npz_path, allow_pickle=False) as z:
             arrays = {k: z[k] for k in z.files}
 
-    params = {}
-    for name, spec in meta["params"].items():
-        kind = spec["kind"]
-        if kind == "json":
-            params[name] = spec["value"]
-        elif kind == "object_array":
-            params[name] = np.asarray(spec["value"], dtype=object)
-        elif kind == "array":
-            params[name] = arrays[spec["ref"]]
-        elif kind == "stage":
-            params[name] = load_stage(os.path.join(path, spec["ref"]))
-        elif kind == "stage_list":
-            params[name] = [load_stage(os.path.join(path, r)) for r in spec["refs"]]
-        else:
-            raise ValueError(f"unknown param kind {kind!r}")
+    params = {name: _decode_value(spec, path, arrays)
+              for name, spec in meta["params"].items()}
 
     stage = cls.__new__(cls)
     stage._paramMap = {}
@@ -154,4 +221,5 @@ def load_stage(path: str):
             state[key] = arrays[ref]
     if state:
         stage._set_state(state)
+    stage._finish_load()
     return stage

@@ -59,6 +59,11 @@ class BoostParams:
     # multiclass / ranking
     num_class: int = 1
     sigmoid: float = 1.0
+    max_position: int = 0             # lambdarank NDCG truncation (0 = off)
+    # user-supplied objective: (margin, y) -> (grad, hess)
+    # (reference: FObjTrait.getGradient, lightgbm/params/FObjTrait.scala:17);
+    # forces the host boosting loop so arbitrary numpy/jax callables work
+    fobj: Optional[Callable] = None
     # control
     seed: int = 0
     early_stopping_round: int = 0
@@ -107,17 +112,36 @@ RENEWAL_OBJECTIVES = ("regression_l1", "quantile", "huber")
 
 
 def _grad_hess(p: BoostParams, margin, y_j, y_onehot, g_idx):
+    if p.fobj is not None:
+        grad, hess = p.fobj(margin, y_j)
+        return jnp.asarray(grad, jnp.float32), jnp.asarray(hess, jnp.float32)
     if p.objective == "multiclass":
         return obj_mod.multiclass_grad_hess(margin, y_onehot)
     if p.objective == "binary":
         return obj_mod.binary_grad_hess(margin, y_j, p.sigmoid)
     if p.objective == "lambdarank":
-        return obj_mod.lambdarank_grad_hess(margin, y_j, g_idx, sigmoid=p.sigmoid)
+        return obj_mod.lambdarank_grad_hess(margin, y_j, g_idx, sigmoid=p.sigmoid,
+                                            max_position=p.max_position)
     if p.objective in ("huber", "quantile"):
         return obj_mod.OBJECTIVES[p.objective](margin, y_j, p.alpha)
     if p.objective == "tweedie":
         return obj_mod.tweedie_grad_hess(margin, y_j, p.tweedie_variance_power)
     return obj_mod.OBJECTIVES[p.objective](margin, y_j)
+
+
+def _presence(pres_j, row_w):
+    """min_data_in_leaf count indicator (None when every row counts — lets
+    the histogram op skip the column). pres_j marks physically-present rows
+    (0 = distributed padding); row_w is the bagging/GOSS mask. User sample
+    weights deliberately do NOT change counts (LightGBM semantics — see
+    histogram._xla_hist)."""
+    present = None
+    if pres_j is not None:
+        present = (pres_j != 0)
+    if row_w is not None:
+        rw = row_w != 0
+        present = rw if present is None else (present & rw)
+    return None if present is None else present.astype(jnp.float32)
 
 
 def _row_weights(p: BoostParams, grad, key, it_offset, multiclass):
@@ -182,9 +206,9 @@ def _device_metric(name, objective, margin, y, num_class):
     jax.jit,
     static_argnames=("p", "cfg", "chunk_len", "k_out", "axis_name",
                      "has_valid", "voting_top_k"))
-def _boost_chunk(d_bins, y_j, w_j, margin, init_margin, v_bins, vy, v_margin,
-                 key, it_base, p: BoostParams, cfg, chunk_len: int, k_out: int,
-                 axis_name=None, has_valid: bool = False,
+def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
+                 v_margin, key, it_base, p: BoostParams, cfg, chunk_len: int,
+                 k_out: int, axis_name=None, has_valid: bool = False,
                  voting_top_k=None):
     """One fused chunk of boosting iterations: a lax.scan with NO host
     round-trips — the design that actually fits the TPU (the reference's
@@ -212,6 +236,9 @@ def _boost_chunk(d_bins, y_j, w_j, margin, init_margin, v_bins, vy, v_margin,
         if row_w is not None:
             grad = grad * (row_w[:, None] if multiclass else row_w)
             hess = hess * (row_w[:, None] if multiclass else row_w)
+        # presence indicator for min_data_in_leaf: bagged-out + padding rows
+        # are absent; genuine rows count 1 regardless of sample weight
+        count_w = _presence(pres_j, row_w)
         fmask = _feature_mask(p, k_feat, cfg.n_features)
 
         sfs, sbs, lvs = [], [], []
@@ -220,7 +247,8 @@ def _boost_chunk(d_bins, y_j, w_j, margin, init_margin, v_bins, vy, v_margin,
             hk = hess[:, k] if multiclass else hess
             tree, delta = trainer.train_one_tree(d_bins, gk, hk, fmask, cfg,
                                                  axis_name=axis_name,
-                                                 voting_top_k=voting_top_k)
+                                                 voting_top_k=voting_top_k,
+                                                 count_w=count_w)
             sfs.append(tree.split_feature)
             sbs.append(tree.split_bin)
             lvs.append(tree.leaf_value)
@@ -283,7 +311,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 init_booster: Optional[Booster] = None,
                 callbacks: Optional[Callbacks] = None,
                 tree_fn=None, put_fn=None, chunk_fn=None,
-                prebinned: Optional[tuple] = None):
+                prebinned: Optional[tuple] = None,
+                presence: Optional[np.ndarray] = None):
     """Train a Booster on host arrays. Single-device by default; the
     distributed path (distributed.py) passes a shard_map-wrapped `tree_fn`
     and a sharding `put_fn`, and this same loop runs over the mesh.
@@ -299,7 +328,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     put = put_fn or jnp.asarray
     custom_tree_fn = tree_fn is not None
     if tree_fn is None:
-        tree_fn = lambda b, g, h, fm, cfg: trainer.train_one_tree(b, g, h, fm, cfg)
+        tree_fn = lambda b, g, h, fm, cfg, cw=None: trainer.train_one_tree(
+            b, g, h, fm, cfg, count_w=cw)
 
     if prebinned is not None:
         # (mapper, device_bins): data already staged on device — training
@@ -311,6 +341,9 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         d_bins = put(binning.apply_bins_device(mapper, x))
     y_j = put(np.asarray(y, dtype=np.float32))
     w_j = None if weights is None else put(np.asarray(weights, dtype=np.float32))
+    # physical-row indicator (0 = distributed padding); user weights must not
+    # affect min_data_in_leaf counts, so this is a separate channel
+    pres_j = None if presence is None else put(np.asarray(presence, np.float32))
     # lambdarank: the padded per-group gather layout is computed once, host-side
     g_idx = (jnp.asarray(obj_mod.make_group_index(group))
              if group is not None else None)
@@ -360,6 +393,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     # the loop). Host-loop fallback covers DART (needs per-tree delta
     # history), L1-family leaf renewal, lambdarank, and delegate callbacks.
     use_fused = (callbacks is None and not dart
+                 and p.fobj is None
                  and p.objective not in RENEWAL_OBJECTIVES
                  and p.objective != "lambdarank"
                  and (chunk_fn is not None or not custom_tree_fn))
@@ -392,7 +426,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             clen = min(chunk, p.num_iterations - it)
             key, kc = jax.random.split(key)
             margin, v_margin_, sf_c, sb_c, lv_c, mts = fused(
-                d_bins, y_j, w_j, margin, margin_init, v_bins_, vy_j,
+                d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_, vy_j,
                 v_margin_, kc, it, p, cfg, clen, k_out, has_valid=has_valid)
             parts.append((sf_c, sb_c, lv_c))
             if track:
@@ -474,6 +508,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             hess = hess * (row_w[:, None] if multiclass else row_w)
 
         fmask = _feature_mask(p, k_feat, n_features)
+        count_w = _presence(pres_j, row_w)
 
         cfg = trainer.TreeConfig(learning_rate=lr, **cfg_base)
         it_deltas = jnp.zeros_like(margin)
@@ -481,7 +516,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         for k in range(k_out):
             gk = grad[:, k] if multiclass else grad
             hk = hess[:, k] if multiclass else hess
-            tree, delta = tree_fn(d_bins, gk, hk, fmask, cfg)
+            tree, delta = tree_fn(d_bins, gk, hk, fmask, cfg, count_w)
             if p.objective in ("regression_l1", "quantile", "huber"):
                 # leaf-output renewal: refit each leaf to the residual
                 # median/quantile (LightGBM's RenewTreeOutput for L1-family
@@ -548,8 +583,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 p.metric, p.objective, v_margin, vy, p.num_class)
             eval_history.append(metric_val)
             improved = (best_metric is None
-                        or (metric_val > best_metric) == larger_better
-                        and metric_val != best_metric)
+                        or ((metric_val > best_metric) == larger_better
+                            and metric_val != best_metric))
             if improved:
                 best_metric, best_iter, rounds_since = metric_val, it, 0
             else:

@@ -24,7 +24,20 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.experimental.shard_map import shard_map
+try:  # jax >= 0.4.35 promotes shard_map out of experimental
+    import inspect as _inspect
+    from jax import shard_map as _shard_map
+    _CHECK_KW = ("check_vma" if "check_vma"
+                 in _inspect.signature(_shard_map).parameters else "check_rep")
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(fn, **kw):
+    """Version-tolerant shard_map (check_rep was renamed check_vma)."""
+    kw[_CHECK_KW] = kw.pop("check_rep", False)
+    return _shard_map(fn, **kw)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...parallel import DATA_AXIS, data_mesh, pad_to_multiple
@@ -36,11 +49,14 @@ from .boosting import fit_booster
 def _compiled_tree_fn(mesh, cfg, voting: Optional[int]):
     """Build + jit the shard_map'd tree grower once per (mesh, config).
     Rebuilding it per call would re-trace and recompile every tree."""
-    fn = functools.partial(trainer.train_one_tree, cfg=cfg,
-                           axis_name=DATA_AXIS, voting_top_k=voting)
+    def fn(bins, grad, hess, fmask, count_w):
+        return trainer.train_one_tree(bins, grad, hess, fmask, cfg=cfg,
+                                      axis_name=DATA_AXIS, voting_top_k=voting,
+                                      count_w=count_w)
     mapped = shard_map(
         fn, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(),
+                  P(DATA_AXIS)),
         out_specs=(trainer.Tree(P(), P(), P()), P(DATA_AXIS)),
         check_rep=False)
     return jax.jit(mapped)
@@ -51,8 +67,12 @@ def make_sharded_tree_fn(mesh, parallelism: str = "data_parallel",
     """shard_map-wrapped train_one_tree: rows in, replicated tree out."""
     voting = top_k if parallelism == "voting_parallel" else None
 
-    def tree_fn(bins, grad, hess, fmask, cfg):
-        return _compiled_tree_fn(mesh, cfg, voting)(bins, grad, hess, fmask)
+    def tree_fn(bins, grad, hess, fmask, cfg, count_w=None):
+        import jax.numpy as jnp
+        if count_w is None:
+            count_w = jnp.ones(bins.shape[0], jnp.float32)
+        return _compiled_tree_fn(mesh, cfg, voting)(bins, grad, hess, fmask,
+                                                    count_w)
 
     return tree_fn
 
@@ -69,8 +89,9 @@ def _compiled_chunk_fn(mesh, p, cfg, chunk_len: int, k_out: int,
     margin_spec = P(DATA_AXIS, None) if multiclass else P(DATA_AXIS)
     mapped = shard_map(
         fn, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), margin_spec,
-                  margin_spec, P(), P(), P(), P(), P()),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), margin_spec, margin_spec, P(), P(), P(), P(),
+                  P()),
         out_specs=(margin_spec, P(), P(), P(), P(), P()),
         check_rep=False)
     return jax.jit(mapped)
@@ -95,6 +116,9 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
     y_p, _ = pad_to_multiple(np.asarray(y, np.float32), nsh)
     w = np.ones(n, np.float32) if weights is None else np.asarray(weights, np.float32)
     w_p, _ = pad_to_multiple(w, nsh)  # padding rows get weight 0
+    # physical-presence channel: padding rows must not count toward
+    # min_data_in_leaf, while user zero weights still do (LightGBM counts)
+    pres_p, _ = pad_to_multiple(np.ones(n, np.float32), nsh)
     init_p = None
     if init_scores is not None:
         init_p, _ = pad_to_multiple(np.asarray(init_scores, np.float32), nsh)
@@ -115,16 +139,20 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
     voting = top_k if parallelism == "voting_parallel" else None
     multiclass = params.objective == "multiclass"
 
-    def chunk_fn(d_bins, y_j, w_j, margin, margin_init, v_bins, vy, v_margin,
-                 key, it_base, p, cfg, chunk_len, k_out, has_valid=False):
+    def chunk_fn(d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins, vy,
+                 v_margin, key, it_base, p, cfg, chunk_len, k_out,
+                 has_valid=False):
         compiled = _compiled_chunk_fn(mesh, p, cfg, chunk_len, k_out,
                                       has_valid, multiclass, voting)
         import jax.numpy as jnp
-        return compiled(d_bins, y_j, w_j, margin, margin_init, v_bins, vy,
-                        v_margin, key, jnp.int32(it_base))
+        if pres_j is None:  # shard_map specs are fixed; materialize ones
+            pres_j = jnp.ones(y_j.shape[0], jnp.float32)
+        return compiled(d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins,
+                        vy, v_margin, key, jnp.int32(it_base))
 
     booster, base, hist = fit_booster(
         x_p, y_p, params, weights=w_p, init_scores=init_p, group=group_p,
         valid=valid, init_booster=init_booster, callbacks=callbacks,
-        tree_fn=tree_fn, put_fn=put_rows, chunk_fn=chunk_fn)
+        tree_fn=tree_fn, put_fn=put_rows, chunk_fn=chunk_fn,
+        presence=pres_p)
     return booster, base, hist

@@ -74,8 +74,19 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     features_shap_col = Param("features_shap_col",
                               "output column for SHAP contributions", None)
 
+    fobj = Param("fobj", "custom objective: (margin, y) -> (grad, hess) "
+                 "(reference: FObjTrait.scala:17)", None, transient=True)
+
     def _boost_params(self, objective: str, num_class: int = 1) -> BoostParams:
         return BoostParams(
+            # objective extras live on subclasses (GBDTRegressor.alpha /
+            # tweedie_variance_power, GBDTRanker.max_position) — getattr with
+            # BoostParams' own field defaults keeps one source of truth
+            alpha=getattr(self, "alpha", BoostParams.alpha),
+            tweedie_variance_power=getattr(self, "tweedie_variance_power",
+                                           BoostParams.tweedie_variance_power),
+            max_position=getattr(self, "max_position", BoostParams.max_position),
+            fobj=self.fobj,
             objective=objective, boosting=self.boosting,
             num_iterations=self.num_iterations, learning_rate=self.learning_rate,
             num_leaves=self.num_leaves, max_depth=self.max_depth,
@@ -132,9 +143,11 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             fit = fit_booster
         if n_batches > 1:
             # batch continuation (reference: LightGBMBase.scala:34-51)
-            booster, base = None, 0.0
+            booster, base, hist = None, 0.0, []
             idx = np.array_split(np.arange(x.shape[0]), n_batches)
             for bi in idx:
+                if bi.size == 0:
+                    continue
                 booster, base, hist = fit(
                     x=x[bi], y=y[bi], params=params,
                     weights=None if w is None else w[bi],

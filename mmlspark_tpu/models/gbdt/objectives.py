@@ -90,12 +90,17 @@ def make_group_index(group_ids):
     return out
 
 
-def lambdarank_grad_hess(scores, y, group_index, sigmoid: float = 1.0):
+def lambdarank_grad_hess(scores, y, group_index, sigmoid: float = 1.0,
+                         max_position: int = 0):
     """LambdaRank gradients with NDCG deltas, blocked per group.
 
     `group_index` is the (n_groups, G) padded matrix from make_group_index;
     pair terms are (n_groups, G, G) — memory scales with the largest group,
     not the dataset. Scatter back to rows via one segment_sum.
+
+    max_position > 0 truncates NDCG: a pair contributes only if either member
+    currently ranks above the cutoff (LightGBM's lambdarank_truncation_level,
+    surfaced by the reference as maxPosition on LightGBMRanker).
     """
     n = scores.shape[0]
     valid = group_index >= 0
@@ -111,6 +116,9 @@ def lambdarank_grad_hess(scores, y, group_index, sigmoid: float = 1.0):
 
     pair_valid = (valid[:, :, None] & valid[:, None, :]
                   & (l[:, :, None] > l[:, None, :]))  # i beats j
+    if max_position > 0:
+        in_top = rank < max_position
+        pair_valid = pair_valid & (in_top[:, :, None] | in_top[:, None, :])
     delta = (jnp.abs(gain[:, :, None] - gain[:, None, :])
              * jnp.abs(disc[:, :, None] - disc[:, None, :]))
     s_fin = jnp.where(valid, scores[idx], 0.0)

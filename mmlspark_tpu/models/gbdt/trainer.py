@@ -84,9 +84,11 @@ def _gain_lattice(hg, hh, hc, feature_mask, cfg: TreeConfig,
     right_h = tot_h - left_h
     right_c = tot_c - left_c
 
-    gain = (_leaf_objective(left_g, left_h, cfg)
-            + _leaf_objective(right_g, right_h, cfg)
-            - _leaf_objective(tot_g, tot_h, cfg))
+    # the 1/2 factor matches LightGBM's gain scale, so a user's
+    # min_gain_to_split threshold means the same thing in both frameworks
+    gain = 0.5 * (_leaf_objective(left_g, left_h, cfg)
+                  + _leaf_objective(right_g, right_h, cfg)
+                  - _leaf_objective(tot_g, tot_h, cfg))
 
     ok = ((left_c >= cfg.min_data_in_leaf)
           & (right_c >= cfg.min_data_in_leaf)
@@ -146,10 +148,15 @@ def _voting_feature_mask(hg, hh, hc, feature_mask, cfg: TreeConfig,
 def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    feature_mask: jnp.ndarray, cfg: TreeConfig,
                    axis_name: Optional[str] = None,
-                   voting_top_k: Optional[int] = None):
+                   voting_top_k: Optional[int] = None,
+                   count_w: Optional[jnp.ndarray] = None):
     """Grow one tree. grad/hess must already fold in sample weights and
-    bagging masks (zeros drop a row). Returns (Tree, new_margin_delta)
-    where delta = leaf_value[resting node] per row.
+    bagging masks (zeros drop a row). `count_w` is the presence indicator for
+    min_data_in_leaf counting (1 = row participates this iteration; 0 =
+    bagged-out/padding) — an explicit arg because hess can legitimately hit
+    exact 0 under f32 sigmoid saturation or custom objectives.
+    Returns (Tree, new_margin_delta) where delta = leaf_value[resting node]
+    per row.
 
     Under shard_map, `axis_name` turns on psum of histograms + node stats:
     the one collective per level that makes training data-parallel.
@@ -159,6 +166,10 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     split_feature = jnp.full(cfg.max_nodes, -1, dtype=jnp.int32)
     split_bin = jnp.zeros(cfg.max_nodes, dtype=jnp.int32)
     leaf_count = jnp.ones((), dtype=jnp.int32)
+    # feature-major bins for row routing: one (n,)-stripe dynamic-slice per
+    # split node beats any (n, F) materialization; shared with pallas_hist's
+    # internal transpose via XLA CSE
+    bins_t = bins.T
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name else x
@@ -182,7 +193,8 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # full histogram pass (voting masks features pre-psum, which is
             # incompatible with sibling subtraction)
             hg, hh, hc = node_feature_histograms(
-                bins, grad, hess, node_local, active, m, cfg.n_bins)
+                bins, grad, hess, node_local, active, m, cfg.n_bins,
+                count_w=count_w)
             if voting:
                 parent_g = psum(hg[:, 0].sum(-1))
                 parent_h = psum(hh[:, 0].sum(-1))
@@ -204,7 +216,7 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             left_active = active & (node_local % 2 == 0)
             lg, lh, lc = node_feature_histograms(
                 bins, grad, hess, node_local // 2, left_active, m // 2,
-                cfg.n_bins)
+                cfg.n_bins, count_w=count_w)
             lg, lh, lc = psum(lg), psum(lh), psum(lc)
             hg = _interleave(lg, prev_hists[0] - lg)
             hh = _interleave(lh, prev_hists[1] - lh)
@@ -235,25 +247,40 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             jnp.where(apply, feat, -1))
         split_bin = split_bin.at[heap_ids].set(jnp.where(apply, thr, 0))
 
-        # advance rows whose node split. All row-wise lookups are one-hot
-        # contractions, not gathers — TPU gathers over n rows are serial,
-        # one-hot multiplies ride the VPU/MXU.
-        node_oh = jax.nn.one_hot(node_local, m, dtype=jnp.float32)  # 0s if inactive
-        tbl = jnp.stack([feat.astype(jnp.float32), thr.astype(jnp.float32),
-                         apply.astype(jnp.float32)], axis=1)  # (m, 3)
-        # HIGHEST precision: bf16 operands would round feature ids > 256
-        rows = jnp.matmul(node_oh, tbl,
-                          precision=jax.lax.Precision.HIGHEST)  # (n, 3)
-        row_feat = rows[:, 0].astype(jnp.int32)
-        row_thr = rows[:, 1].astype(jnp.int32)
-        row_apply = active & (rows[:, 2] > 0.5)
-        feat_oh = jax.nn.one_hot(row_feat, cfg.n_features, dtype=jnp.float32)
-        # elementwise multiply-reduce (not a dot) — stays exact in f32
-        row_bin = jnp.sum(bins.astype(jnp.float32) * feat_oh,
-                          axis=1).astype(jnp.int32)
-        go_left = row_bin <= row_thr
-        child = jnp.where(go_left, 2 * node_of_row + 1, 2 * node_of_row + 2)
-        node_of_row = jnp.where(row_apply, child, node_of_row)
+        # advance rows whose node split. Two gather-free strategies (TPU
+        # row-gathers over n are serial):
+        if m <= 64:
+            # per-node row stripes: each split node costs one dynamic-slice
+            # of transposed bins (n bytes) + a fused select chain — no n x F
+            # or n x m materialization at all. Unrolled so XLA fuses the
+            # whole level into one elementwise pass.
+            for j in range(m):
+                bj = jax.lax.dynamic_index_in_dim(bins_t, feat[j], 0,
+                                                  keepdims=False)  # (n,) u8
+                heap_j = level_base + j
+                child_j = jnp.where(bj.astype(jnp.int32) <= thr[j],
+                                    2 * heap_j + 1, 2 * heap_j + 2)
+                upd = (node_local == j) & apply[j]
+                node_of_row = jnp.where(upd, child_j, node_of_row)
+        else:
+            # deep levels (m > 64): unrolling would blow up the program;
+            # one-hot contractions cost O(n*(m+F)) but stay fully parallel.
+            node_oh = jax.nn.one_hot(node_local, m, dtype=jnp.float32)
+            tbl = jnp.stack([feat.astype(jnp.float32), thr.astype(jnp.float32),
+                             apply.astype(jnp.float32)], axis=1)  # (m, 3)
+            # HIGHEST precision: bf16 operands would round feature ids > 256
+            rows = jnp.matmul(node_oh, tbl,
+                              precision=jax.lax.Precision.HIGHEST)  # (n, 3)
+            row_feat = rows[:, 0].astype(jnp.int32)
+            row_thr = rows[:, 1].astype(jnp.int32)
+            row_apply = active & (rows[:, 2] > 0.5)
+            feat_oh = jax.nn.one_hot(row_feat, cfg.n_features, dtype=jnp.float32)
+            # elementwise multiply-reduce (not a dot) — stays exact in f32
+            row_bin = jnp.sum(bins.astype(jnp.float32) * feat_oh,
+                              axis=1).astype(jnp.int32)
+            go_left = row_bin <= row_thr
+            child = jnp.where(go_left, 2 * node_of_row + 1, 2 * node_of_row + 2)
+            node_of_row = jnp.where(row_apply, child, node_of_row)
 
     # leaf values from resting nodes (shrinkage applied here, like LightGBM);
     # segment sums and the delta lookup as one-hot matmuls, not scatters

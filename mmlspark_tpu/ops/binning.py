@@ -8,8 +8,9 @@ producing a dense (n_rows, n_features) uint8 matrix that lives in HBM — 4-8x
 smaller than f32 features, which is what makes histogram building HBM-friendly.
 
 Bin semantics match LightGBM's: bin b holds values x <= upper_bound[b], the last
-bin is +inf, NaN maps to a dedicated missing bin (bin 0 by convention here, with
-`use_missing`), matching `zero_as_missing=False` defaults.
+bin is +inf. NaN maps to the LAST bin of each feature (missing treated as
+largest — LightGBM's default missing-value direction with `use_missing` and
+`zero_as_missing=False`).
 """
 from __future__ import annotations
 
@@ -53,7 +54,9 @@ def fit_bins(x: np.ndarray, max_bin: int = 255,
             # distinct-value bins: boundary = midpoint between neighbors
             bounds = (uniq[:-1] + uniq[1:]) / 2.0
         else:
-            qs = np.linspace(0, 1, max_bin)[1:-1]
+            # max_bin+1 grid points -> max_bin-1 interior boundaries ->
+            # a full max_bin bins (was off by one before)
+            qs = np.linspace(0, 1, max_bin + 1)[1:-1]
             bounds = np.unique(np.quantile(col, qs))
         k = min(bounds.size, max_bin - 1)
         ubs[j, :k] = bounds[:k]

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_hist(bins, grad, hess, node_local, active, n_nodes: int, n_bins: int):
+def _xla_hist(bins, grad, hess, node_local, active, n_nodes: int, n_bins: int,
+              count_w=None):
     """One fused scatter-add: key = ((node * F) + f) * B + bin.
 
     Inactive rows get an out-of-range segment id and are dropped by XLA's
@@ -36,17 +37,23 @@ def _xla_hist(bins, grad, hess, node_local, active, n_nodes: int, n_bins: int):
                                   num_segments=num_segments)
         return out.reshape(n_nodes, f, n_bins)
 
-    ones = jnp.ones((n, 1), dtype=jnp.float32)
+    # count histogram: count_w is the bagging/padding indicator (1 = row is
+    # present this iteration, 0 = bagged-out / GOSS-dropped / distributed
+    # padding). LightGBM removes such rows from data counts; user sample
+    # weights do NOT change counts, so this must be an indicator, not hess.
+    cnt = (jnp.ones_like(hess) if count_w is None
+           else count_w.astype(jnp.float32))
     hg = seg(jnp.broadcast_to(grad[:, None], (n, f)))
     hh = seg(jnp.broadcast_to(hess[:, None], (n, f)))
-    hc = seg(jnp.broadcast_to(ones, (n, f)))
+    hc = seg(jnp.broadcast_to(cnt[:, None], (n, f)))
     return hg, hh, hc
 
 
 def node_feature_histograms(bins, grad, hess, node_local, active,
-                            n_nodes: int, n_bins: int):
+                            n_nodes: int, n_bins: int, count_w=None):
     """(n,F) uint8 bins + per-row grad/hess -> three (n_nodes, F, n_bins) f32
-    histograms. Rows with active=False contribute nothing."""
+    histograms. Rows with active=False contribute nothing; rows with
+    count_w=0 contribute to no statistic's count (see _xla_hist)."""
     impl = os.environ.get("MMLSPARK_TPU_HIST", "auto")
     use_pallas = (impl == "pallas"
                   or (impl == "auto" and _should_use_pallas(n_nodes)))
@@ -61,8 +68,10 @@ def node_feature_histograms(bins, grad, hess, node_local, active,
                     "use the XLA scatter path") from e
             use_pallas = False
     if use_pallas:
-        return pallas_hist(bins, grad, hess, node_local, active, n_nodes, n_bins)
-    return _xla_hist(bins, grad, hess, node_local, active, n_nodes, n_bins)
+        return pallas_hist(bins, grad, hess, node_local, active, n_nodes,
+                           n_bins, count_w=count_w)
+    return _xla_hist(bins, grad, hess, node_local, active, n_nodes, n_bins,
+                     count_w=count_w)
 
 
 def _should_use_pallas(n_nodes: int) -> bool:

@@ -25,9 +25,6 @@ def auc(y_true, scores):
     """Rank-statistic AUC (Mann-Whitney), ties averaged."""
     y_true = np.asarray(y_true)
     scores = np.asarray(scores, dtype=np.float64)
-    order = np.argsort(scores, kind="stable")
-    ranks = np.empty(len(scores), dtype=np.float64)
-    ranks[order] = np.arange(1, len(scores) + 1)
     # average ranks for ties
     uniq, inv, counts = np.unique(scores, return_inverse=True, return_counts=True)
     cum = np.cumsum(counts)

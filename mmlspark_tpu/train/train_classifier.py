@@ -65,22 +65,17 @@ class TrainedClassifierModel(Model, HasLabelCol):
     def inner_model(self):
         return self._model
 
-    def save(self, path):
-        self.set(stages=[s for s in [self._featurizer, self._model,
-                                     self._label_model] if s is not None])
-        super().save(path)
-
     stages = Param("stages", "nested fitted stages (persistence only)", None)
 
-    @classmethod
-    def load(cls, path):
-        from ..core import serialize
-        m = serialize.load_stage(path)
-        stages = m.get("stages") or []
-        m._featurizer = stages[0] if len(stages) > 0 else None
-        m._model = stages[1] if len(stages) > 1 else None
-        m._label_model = stages[2] if len(stages) > 2 else None
-        return m
+    def _prepare_save(self):
+        self.set(stages=[s for s in [self._featurizer, self._model,
+                                     self._label_model] if s is not None])
+
+    def _finish_load(self):
+        stages = self.get("stages") or []
+        self._featurizer = stages[0] if len(stages) > 0 else None
+        self._model = stages[1] if len(stages) > 1 else None
+        self._label_model = stages[2] if len(stages) > 2 else None
 
     def _transform(self, t: Table) -> Table:
         out = self._featurizer.transform(t)
@@ -126,18 +121,13 @@ class TrainedRegressorModel(Model, HasLabelCol):
         super().__init__(**kw)
         self._featurizer = self._model = None
 
-    def save(self, path):
+    def _prepare_save(self):
         self.set(stages=[self._featurizer, self._model])
-        super().save(path)
 
-    @classmethod
-    def load(cls, path):
-        from ..core import serialize
-        m = serialize.load_stage(path)
-        stages = m.get("stages") or []
-        m._featurizer = stages[0] if len(stages) > 0 else None
-        m._model = stages[1] if len(stages) > 1 else None
-        return m
+    def _finish_load(self):
+        stages = self.get("stages") or []
+        self._featurizer = stages[0] if len(stages) > 0 else None
+        self._model = stages[1] if len(stages) > 1 else None
 
     @property
     def inner_model(self):
