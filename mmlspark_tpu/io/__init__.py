@@ -1,0 +1,12 @@
+"""IO layer: HTTP client transformers, model serving, writers
+(reference: io/ — SURVEY.md §2.6/§2.7)."""
+from .http import (HTTPTransformer, SimpleHTTPTransformer, JSONInputParser,
+                   JSONOutputParser, StringOutputParser, CustomInputParser,
+                   CustomOutputParser, PartitionConsolidator, HTTPRequest,
+                   HTTPResponse)
+from .serving import ServingServer, serve_pipeline, ServingQuery
+
+__all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
+           "JSONOutputParser", "StringOutputParser", "CustomInputParser",
+           "CustomOutputParser", "PartitionConsolidator", "HTTPRequest",
+           "HTTPResponse", "ServingServer", "serve_pipeline", "ServingQuery"]

@@ -1,0 +1,308 @@
+"""Model serving runtime: HTTP in -> pipeline -> HTTP reply, with epoch-based
+replay fault tolerance.
+
+Role-equivalent to Spark Serving (reference:
+org/apache/spark/sql/execution/streaming/continuous/HTTPSourceV2.scala):
+
+- `ServingServer` plays WorkerServer (:475-697): an HTTP server whose handler
+  enqueues each exchange as a `CachedRequest` into a per-partition queue and
+  BLOCKS the client until `reply_to` routes a response back (:535-553).
+  Requests are round-robined over N logical partitions (the v1
+  `MultiChannelMap`, DistributedHTTPSource.scala:27-88).
+- Epoch replay: each partition drains its queue in epochs; batches are kept
+  in `history` until `commit(epoch, pid)` (the streaming checkpoint commit,
+  :555-567). A worker (re)registering at an uncommitted epoch receives the
+  cached batch again (`registerPartition` recovery, :488-505) — in-flight
+  HTTP requests survive worker death.
+- `ServingQuery` plays the streaming engine: one worker thread per partition
+  pulls a batch, runs the PipelineModel, replies per row, commits.
+  `mode="continuous"` is the sub-millisecond path: batch size 1, no batching
+  latency (reference: continuousServer, docs/mmlspark-serving.md:93).
+- `ServingUDFs.sendReplyUDF` equivalent: a worker replies mid-pipeline via
+  `server.reply_to`, or the query replies with the configured output column.
+
+TPU note: partitions map to devices the way Serving pins pipelines to
+executors; a compiled (jitted) pipeline per partition keeps the hot path
+host->device-free for tree models (numpy scoring) and one dispatch for
+deep-net stages.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core import Table
+
+
+class CachedRequest:
+    """One held HTTP exchange (reference: CachedRequest, HTTPSourceV2.scala:519)."""
+
+    __slots__ = ("id", "body", "headers", "path", "_event", "_response")
+
+    def __init__(self, body: bytes, headers: dict, path: str):
+        self.id = uuid.uuid4().hex
+        self.body = body
+        self.headers = headers
+        self.path = path
+        self._event = threading.Event()
+        self._response: Optional[tuple] = None
+
+    def respond(self, status: int, body: bytes,
+                content_type: str = "application/json"):
+        self._response = (status, body, content_type)
+        self._event.set()
+
+    def wait(self, timeout: Optional[float]):
+        ok = self._event.wait(timeout)
+        return self._response if ok else None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mmlspark_tpu-serving/1.0"
+
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        cached = CachedRequest(body, dict(self.headers), self.path)
+        serving: "ServingServer" = self.server.serving  # type: ignore
+        serving._enqueue(cached)
+        resp = cached.wait(serving.reply_timeout)
+        if resp is None:
+            self.send_response(504)
+            self.end_headers()
+            self.wfile.write(b'{"error": "serving timeout"}')
+            return
+        status, payload, ctype = resp
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class ServingServer:
+    """Per-host HTTP ingress with N logical partitions and epoch replay
+    (reference: WorkerServer + HTTPSourceStateHolder, HTTPSourceV2.scala)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 num_partitions: int = 1, reply_timeout: float = 30.0):
+        self.num_partitions = num_partitions
+        self.reply_timeout = reply_timeout
+        self._queues = [queue.Queue() for _ in range(num_partitions)]
+        self._rr = itertools.count()
+        # (partition, epoch) -> list[CachedRequest]; GC'd on commit
+        self._history: dict = {}
+        self._epochs = [0] * num_partitions
+        self._routing: dict = {}  # request id -> CachedRequest
+        self._lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.serving = self  # type: ignore
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServingServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    # -- ingress ------------------------------------------------------------
+    def _enqueue(self, req: CachedRequest):
+        pid = next(self._rr) % self.num_partitions
+        with self._lock:
+            self._routing[req.id] = req
+        self._queues[pid].put(req)
+
+    # -- source API (per-partition readers) ---------------------------------
+    def get_batch(self, pid: int, max_rows: int = 64,
+                  timeout: float = 0.05) -> tuple:
+        """Drain up to max_rows requests for partition pid; returns
+        (epoch, [CachedRequest]). Replayed batches take priority — a worker
+        re-registering at an uncommitted epoch sees the same data again
+        (reference: registerPartition recovery, HTTPSourceV2.scala:488-505)."""
+        with self._lock:
+            epoch = self._epochs[pid]
+            cached = self._history.get((pid, epoch))
+        if cached is not None:
+            # filter requests already answered (client may have timed out)
+            alive = [r for r in cached if not r._event.is_set()]
+            return epoch, alive
+        batch = []
+        try:
+            batch.append(self._queues[pid].get(timeout=timeout))
+            while len(batch) < max_rows:
+                batch.append(self._queues[pid].get_nowait())
+        except queue.Empty:
+            pass
+        with self._lock:
+            self._history[(pid, epoch)] = batch
+        return epoch, batch
+
+    def commit(self, epoch: int, pid: int):
+        """Epoch commit: GC history and advance (HTTPSourceV2.scala:555-567)."""
+        with self._lock:
+            batch = self._history.pop((pid, epoch), []) or []
+            for r in batch:
+                self._routing.pop(r.id, None)
+            self._epochs[pid] = epoch + 1
+
+    # -- sink API -----------------------------------------------------------
+    def reply_to(self, request_id: str, data, status: int = 200):
+        """Route a response to the held exchange (HTTPSourceV2.scala:535-553)."""
+        with self._lock:
+            req = self._routing.get(request_id)
+        if req is None:
+            return False
+        if isinstance(data, bytes):
+            payload, ctype = data, "application/octet-stream"
+        elif isinstance(data, str):
+            payload, ctype = data.encode(), "text/plain"
+        else:
+            payload, ctype = json.dumps(_jsonable(data)).encode(), "application/json"
+        req.respond(status, payload, ctype)
+        return True
+
+
+def _jsonable(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return v.item()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+class ServingQuery:
+    """Streaming engine stand-in: per-partition worker threads pulling
+    batches through a model and replying (reference: the executor-local
+    request->pipeline->reply path, SURVEY.md §3.4)."""
+
+    def __init__(self, server: ServingServer, transform_fn: Callable,
+                 mode: str = "microbatch", max_batch: int = 64,
+                 poll_timeout: float = 0.02):
+        if mode not in ("microbatch", "continuous"):
+            raise ValueError("mode must be microbatch|continuous")
+        self.server = server
+        self.transform_fn = transform_fn
+        self.max_batch = 1 if mode == "continuous" else max_batch
+        self.poll_timeout = poll_timeout
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._errors: list = []
+        self._inject: set = set()  # partitions poisoned by inject_fault
+        self._recoveries = 0
+
+    def start(self) -> "ServingQuery":
+        for pid in range(self.server.num_partitions):
+            th = threading.Thread(target=self._work, args=(pid,), daemon=True)
+            th.start()
+            self._threads.append(th)
+        return self
+
+    MAX_REPLAYS = 3  # per epoch; then the batch is failed out (502) and
+    # committed so one poison request can't wedge its partition forever
+
+    def _work(self, pid: int):
+        replays = 0
+        while not self._stop.is_set():
+            batch: list = []
+            try:
+                epoch, batch = self.server.get_batch(
+                    pid, self.max_batch, timeout=self.poll_timeout)
+                if pid in self._inject and batch:
+                    # die between read and commit — the worst spot: requests
+                    # are in flight. History must replay them to the next
+                    # attempt (reference: HTTPv2Suite "fault tolerance" :329).
+                    self._inject.discard(pid)
+                    raise RuntimeError("injected worker death")
+                if not batch:
+                    self.server.commit(epoch, pid)
+                    continue
+                self._process(pid, epoch, batch)
+                self.server.commit(epoch, pid)
+                replays = 0
+            except Exception as e:  # noqa: BLE001 - worker survives task errors
+                if len(self._errors) < 1000:
+                    self._errors.append(e)
+                self._recoveries += 1
+                replays += 1
+                if batch and replays > self.MAX_REPLAYS:
+                    # poison batch: answer 502 and move on rather than
+                    # replaying forever (bounded replay keeps the reference's
+                    # replay guarantee for transient faults while surviving
+                    # malformed inputs)
+                    for r in batch:
+                        self.server.reply_to(r.id, {"error": str(e)},
+                                             status=502)
+                    self.server.commit(epoch, pid)
+                    replays = 0
+                else:
+                    # no commit -> epoch unchanged -> history replays;
+                    # brief backoff so a failing loop doesn't hot-spin
+                    time.sleep(0.01 * replays)
+
+    def _process(self, pid: int, epoch: int, batch: list):
+        bodies = [r.body for r in batch]
+        replies = self.transform_fn(bodies)
+        for r, reply in zip(batch, replies):
+            self.server.reply_to(r.id, reply)
+
+    def stop(self):
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=5)
+
+    def inject_fault(self, pid: int):
+        """Fault injection for tests: the next batch read on `pid` dies
+        mid-flight; epoch replay must redeliver it (WorkerServer
+        registerPartition recovery, HTTPSourceV2.scala:488-505)."""
+        self._inject.add(pid)
+
+
+def serve_pipeline(model, input_cols, output_col: str = "prediction",
+                   host: str = "127.0.0.1", port: int = 0,
+                   num_partitions: int = 1, mode: str = "microbatch",
+                   max_batch: int = 64):
+    """One-call serving of a fitted PipelineModel: JSON rows in, scored
+    column out (reference: the readStream.server().load() ->
+    pipeline -> writeStream.server() composition, IOImplicits.scala).
+
+    Each request body is a JSON object {col: value, ...}; the reply is
+    {output_col: value}. Returns (server, query); stop with query.stop() +
+    server.stop().
+    """
+    server = ServingServer(host, port, num_partitions).start()
+
+    def transform(bodies: list) -> list:
+        rows = [json.loads(b) for b in bodies]
+        cols = {}
+        for c in input_cols:
+            cols[c] = np.asarray([row[c] for row in rows])
+        out = model.transform(Table(cols))
+        vals = np.asarray(out[output_col])
+        return [{output_col: _jsonable(v)} for v in vals]
+
+    q = ServingQuery(server, transform, mode=mode, max_batch=max_batch).start()
+    return server, q

@@ -1,0 +1,8 @@
+"""Host-side utility layer (reference: core/utils/ + core/env/)."""
+from .async_utils import buffered_await, bounded_map
+from .retry import retry_with_timeout
+from .stopwatch import StopWatch
+from .stream_utils import using, using_many
+
+__all__ = ["buffered_await", "bounded_map", "retry_with_timeout", "StopWatch",
+           "using", "using_many"]

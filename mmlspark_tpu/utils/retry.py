@@ -1,0 +1,43 @@
+"""Fault-tolerance retry helper.
+
+Role-equivalent to FaultToleranceUtils.retryWithTimeout
+(reference: downloader/ModelDownloader.scala:37-64), reused there by LightGBM
+network init (lightgbm/TrainUtils.scala:662) and VW training
+(vw/VowpalWabbitBase.scala:347): run `fn` under a timeout, retrying with
+exponential backoff.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+def retry_with_timeout(fn: Callable[[], T], times: int = 3,
+                       timeout: float = 60.0, backoff: float = 0.1,
+                       backoff_factor: float = 2.0,
+                       retry_on: tuple = (Exception,)) -> T:
+    """Call fn() with a per-attempt timeout; on failure retry up to `times`
+    total attempts with exponential backoff. Raises the last error."""
+    last: BaseException = RuntimeError("retry_with_timeout: times < 1")
+    delay = backoff
+    # one shared executor torn down with shutdown(wait=False): a hung
+    # attempt's thread is abandoned rather than joined — `with
+    # ThreadPoolExecutor(...)` would block shutdown on the hung fn and
+    # defeat the timeout entirely
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=times, thread_name_prefix="retry_with_timeout")
+    try:
+        for attempt in range(times):
+            try:
+                return pool.submit(fn).result(timeout=timeout)
+            except retry_on as e:  # includes FutureTimeoutError
+                last = e
+                if attempt + 1 < times:
+                    time.sleep(delay)
+                    delay *= backoff_factor
+        raise last
+    finally:
+        pool.shutdown(wait=False)

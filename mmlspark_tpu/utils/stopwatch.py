@@ -1,0 +1,46 @@
+"""Wall-clock accumulator (reference: core/utils/StopWatch.scala), feeding
+per-phase diagnostics the way VW's TrainingStats ns-timers do
+(vw/VowpalWabbitBase.scala:27-46)."""
+from __future__ import annotations
+
+import time
+
+
+class StopWatch:
+    def __init__(self):
+        self._elapsed_ns = 0
+        self._started = None
+
+    def start(self) -> "StopWatch":
+        self._started = time.perf_counter_ns()
+        return self
+
+    def stop(self) -> "StopWatch":
+        if self._started is not None:
+            self._elapsed_ns += time.perf_counter_ns() - self._started
+            self._started = None
+        return self
+
+    def restart(self) -> "StopWatch":
+        self._elapsed_ns = 0
+        return self.start()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def measure(self, fn):
+        with self:
+            return fn()
+
+    @property
+    def elapsed_ns(self) -> int:
+        live = (time.perf_counter_ns() - self._started
+                if self._started is not None else 0)
+        return self._elapsed_ns + live
+
+    @property
+    def elapsed(self) -> float:
+        return self.elapsed_ns / 1e9

@@ -1,0 +1,36 @@
+"""Resource brackets (reference: core/env/StreamUtilities.scala:15+ —
+`using`/`usingMany` wrap close() calls with error capture)."""
+from __future__ import annotations
+
+from typing import Callable, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def using(resource: T, fn: Callable[[T], R]) -> R:
+    """Run fn(resource), always closing the resource afterwards."""
+    try:
+        return fn(resource)
+    finally:
+        close = getattr(resource, "close", None)
+        if close is not None:
+            close()
+
+
+def using_many(resources: Sequence[T], fn: Callable[[Sequence[T]], R]) -> R:
+    """Run fn(resources), closing every resource afterwards (best effort:
+    all closes run; the first close error propagates if fn succeeded)."""
+    try:
+        return fn(resources)
+    finally:
+        errors = []
+        for r in resources:
+            close = getattr(r, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception as e:  # noqa: BLE001 - collect, raise below
+                    errors.append(e)
+        if errors:
+            raise errors[0]

@@ -1,0 +1,240 @@
+"""HTTP client stack + Serving runtime suites (mirror the reference's
+HTTPTransformerSuite / SimpleHTTPTransformerSuite / HTTPv2Suite incl. the
+fault-tolerance (:329) and flaky-connection (:401) scenarios)."""
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.io import (CustomOutputParser, HTTPRequest, HTTPResponse,
+                             HTTPTransformer, JSONInputParser, JSONOutputParser,
+                             PartitionConsolidator, SimpleHTTPTransformer,
+                             StringOutputParser, ServingServer, ServingQuery,
+                             serve_pipeline)
+from fuzzing import fuzz_transformer
+
+FUZZ_COVERED = ["HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
+                "JSONOutputParser", "StringOutputParser", "CustomInputParser",
+                "CustomOutputParser", "PartitionConsolidator"]
+
+
+# ---------------------------------------------------------------- test server
+class _EchoHandler(BaseHTTPRequestHandler):
+    flaky_fail_count = 0
+    rate_limit_remaining = 0
+    lock = threading.Lock()
+
+    def do_POST(self):
+        cls = _EchoHandler
+        with cls.lock:
+            if cls.flaky_fail_count > 0:
+                cls.flaky_fail_count -= 1
+                self.connection.close()  # simulate dropped connection
+                return
+            if cls.rate_limit_remaining > 0:
+                cls.rate_limit_remaining -= 1
+                self.send_response(429)
+                self.send_header("Retry-After", "0.01")
+                self.end_headers()
+                return
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        try:
+            val = json.loads(body)
+        except ValueError:
+            val = None
+        out = json.dumps({"echo": val}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _EchoHandler)
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}/"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _requests_col(url, vals):
+    col = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        col[i] = HTTPRequest(url=url, method="POST",
+                             headers={"Content-Type": "application/json"},
+                             body=json.dumps(v).encode())
+    return col
+
+
+# ---------------------------------------------------------------- client
+def test_http_transformer_roundtrip(echo_server):
+    t = Table({"req": _requests_col(echo_server, [1, 2, 3])})
+    ht = HTTPTransformer(input_col="req", output_col="resp", concurrency=3)
+    out = ht.transform(t)
+    for i, r in enumerate(out["resp"]):
+        assert r.status == 200
+        assert r.json() == {"echo": i + 1}
+
+
+def test_http_transformer_fuzzed(echo_server):
+    # serialization fuzz on the stage itself (request col rebuilt after load)
+    t = Table({"req": _requests_col(echo_server, ["a"])})
+    fuzz_transformer(HTTPTransformer(input_col="req", output_col="resp"), t,
+                     rtol=np.inf)  # responses compare by column presence only
+
+
+def test_flaky_connection_retry(echo_server):
+    """reference: HTTPv2Suite flaky connection test (:401) — advanced handler
+    retries dropped connections."""
+    _EchoHandler.flaky_fail_count = 2
+    t = Table({"req": _requests_col(echo_server, [42])})
+    out = HTTPTransformer(input_col="req", output_col="resp", retry_times=4,
+                          backoff=0.01).transform(t)
+    assert out["resp"][0].status == 200
+    assert out["resp"][0].json() == {"echo": 42}
+
+
+def test_429_backoff(echo_server):
+    _EchoHandler.rate_limit_remaining = 1
+    t = Table({"req": _requests_col(echo_server, [7])})
+    out = HTTPTransformer(input_col="req", output_col="resp", retry_times=3,
+                          backoff=0.01).transform(t)
+    assert out["resp"][0].status == 200
+
+
+def test_basic_handler_no_retry(echo_server):
+    _EchoHandler.rate_limit_remaining = 1
+    t = Table({"req": _requests_col(echo_server, [7])})
+    out = HTTPTransformer(input_col="req", output_col="resp",
+                          handler="basic").transform(t)
+    assert out["resp"][0].status == 429
+
+
+def test_simple_http_transformer(echo_server):
+    t = Table({"x": np.asarray([1.5, 2.5])})
+    s = SimpleHTTPTransformer(input_col="x", output_col="y", url=echo_server,
+                              concurrency=2)
+    out = s.transform(t)
+    assert [v["echo"] for v in out["y"]] == [1.5, 2.5]
+    assert set(out.columns) == {"x", "y"}
+
+
+def test_parsers(echo_server):
+    resp = HTTPResponse(status=200, body=b'{"a": 1}')
+    t = Table({"r": np.asarray([resp], dtype=object)})
+    assert JSONOutputParser(input_col="r", output_col="o").transform(t)["o"][0] == {"a": 1}
+    assert StringOutputParser(input_col="r", output_col="o").transform(t)["o"][0] == '{"a": 1}'
+    p = CustomOutputParser(input_col="r", output_col="o",
+                           udf=lambda r: r.status * 2)
+    assert p.transform(t)["o"][0] == 400
+
+
+def test_partition_consolidator(echo_server):
+    t = Table({"x": np.arange(8).astype(np.float32)}, npartitions=4)
+    inner = SimpleHTTPTransformer(input_col="x", output_col="y", url=echo_server)
+    out = PartitionConsolidator(inner=inner).transform(t)
+    assert out.npartitions == 4
+    assert len(out["y"]) == 8
+
+
+# ---------------------------------------------------------------- serving
+def _post(url, obj, timeout=10):
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 headers={"Content-Type": "application/json"},
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_serving_basic():
+    """request -> pipeline -> reply round trip with a real fitted model."""
+    from mmlspark_tpu.models.linear import LogisticRegression
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    model = LogisticRegression(max_iter=100).fit(Table({"features": x, "label": y}))
+
+    server, q = serve_pipeline(model, input_cols=["features"],
+                               num_partitions=2)
+    try:
+        url = server.address
+        for v in ([1.0, 0, 0, 0], [-1.0, 0, 0, 0]):
+            out = _post(url, {"features": v})
+            assert out["prediction"] == (1.0 if v[0] > 0 else 0.0)
+        # concurrent clients across partitions
+        results = []
+        def client(i):
+            results.append(_post(url, {"features": [float(i % 3 - 1), 0, 0, 0]}))
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(results) == 8
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_serving_fault_tolerance():
+    """reference: HTTPv2Suite fault-tolerance test (:329) — a worker dying
+    mid-batch must not lose in-flight requests; epoch replay redelivers."""
+    server = ServingServer(num_partitions=1, reply_timeout=20).start()
+    q = ServingQuery(server, lambda bodies: [{"ok": json.loads(b)["v"]}
+                                             for b in bodies])
+    q.inject_fault(0)  # first batch read dies between read and commit
+    q.start()
+    try:
+        out = _post(server.address, {"v": 99}, timeout=20)
+        assert out == {"ok": 99}
+        assert q._recoveries >= 1  # the fault actually fired
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_serving_continuous_latency():
+    """continuous mode: measure p50 end-to-end HTTP latency (the reference
+    claims sub-ms executor-local; over localhost HTTP we assert a sane
+    bound and report the number)."""
+    server = ServingServer(num_partitions=1).start()
+    q = ServingQuery(server, lambda bodies: [{"v": 1} for _ in bodies],
+                     mode="continuous", poll_timeout=0.001).start()
+    try:
+        url = server.address
+        _post(url, {"warm": 1})
+        lat = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            _post(url, {"x": 1})
+            lat.append(time.perf_counter() - t0)
+        p50 = sorted(lat)[len(lat) // 2] * 1000
+        print(f"serving p50 latency: {p50:.2f} ms")
+        assert p50 < 100, f"p50 {p50:.1f}ms unreasonably slow"
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_serving_epoch_commit_gc():
+    server = ServingServer(num_partitions=1).start()
+    q = ServingQuery(server, lambda bodies: [{} for _ in bodies]).start()
+    try:
+        _post(server.address, {"a": 1})
+        time.sleep(0.3)
+        assert not server._history  # committed epochs are GC'd
+    finally:
+        q.stop()
+        server.stop()
