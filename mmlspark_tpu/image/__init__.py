@@ -1,0 +1,6 @@
+"""Image pipeline stages (reference: image/ + opencv/ — SURVEY.md §2.5)."""
+from .ops import (ImageSetAugmenter, ImageTransformer, ResizeImageTransformer,
+                  UnrollImage, read_image_dir)
+
+__all__ = ["ImageSetAugmenter", "ImageTransformer", "ResizeImageTransformer",
+           "UnrollImage", "read_image_dir"]

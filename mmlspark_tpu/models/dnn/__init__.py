@@ -1,0 +1,6 @@
+"""Deep-net inference engine (reference: cntk/ + image/ — SURVEY.md §2.5)."""
+from .model import DNNModel
+from .resnet import ResNet, resnet18, resnet50
+from .image_featurizer import ImageFeaturizer
+
+__all__ = ["DNNModel", "ResNet", "resnet18", "resnet50", "ImageFeaturizer"]

@@ -1,0 +1,100 @@
+"""ImageFeaturizer: transfer-learning featurization through a deep net.
+
+Role-equivalent to image/ImageFeaturizer.scala:40-215 — wraps a deep model,
+auto-prepends resize+unroll, and either cuts the output layers to emit
+intermediate features (cutOutputLayers, :100-108) or keeps the full head.
+The model comes from the zoo (`resnet18`/`resnet50`, models/dnn/resnet.py) or
+any (apply_fn, params) pair — the ModelDownloader role is played by
+`mmlspark_tpu.downloader`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core import Model, Param, Table, HasInputCol, HasOutputCol
+from ...image.ops import ResizeImageTransformer, _to_batch
+from .model import DNNModel
+
+
+class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
+    cut_output_layers = Param(
+        "cut_output_layers",
+        "1 = drop the classifier head and emit pooled features (transfer "
+        "learning); 0 = full model logits", 1)
+    image_height = Param("image_height", "resize target", 224)
+    image_width = Param("image_width", "resize target", 224)
+    batch_size = Param("batch_size", "inference minibatch", 32)
+    scale = Param("scale", "pixel scaling", 1.0 / 255.0)
+    dtype = Param("dtype", "on-device compute dtype", "bfloat16")
+
+    def __init__(self, model_name: str = "resnet18", variables=None,
+                 num_classes: int = 1000, seed: int = 0, **kw):
+        kw.setdefault("input_col", "image")
+        kw.setdefault("output_col", "features")
+        super().__init__(**kw)
+        self.set(model_name=model_name)
+        self._variables = variables
+        self._num_classes = num_classes
+        self._seed = seed
+        self._dnn: Optional[DNNModel] = None
+
+    model_name = Param("model_name", "zoo model (resnet18|resnet50)", "resnet18")
+
+    def set_model(self, schema) -> "ImageFeaturizer":
+        """Accept a downloader ModelSchema (reference: setModel,
+        ImageFeaturizer.scala:81-85)."""
+        self.set(model_name=schema.name)
+        if schema.variables is not None:
+            self._variables = schema.variables
+        return self
+
+    def _get_state(self):
+        import jax
+        if self._variables is None:
+            return {}
+        from .model import _treedef_to_str
+        leaves, _ = jax.tree_util.tree_flatten(self._variables)
+        state = {"treedef": _treedef_to_str(self._variables),
+                 "n_leaves": len(leaves)}
+        for i, leaf in enumerate(leaves):
+            state[f"leaf_{i}"] = np.asarray(leaf)
+        return state
+
+    def _set_state(self, s):
+        from .model import _treedef_from_str
+        n = int(np.asarray(s.get("n_leaves", 0)))
+        if n:
+            leaves = [np.asarray(s[f"leaf_{i}"]) for i in range(n)]
+            self._variables = _treedef_from_str(str(s["treedef"]), leaves)
+
+    def _build(self):
+        import jax.numpy as jnp
+        from . import resnet as zoo
+        cut = "features" if self.cut_output_layers else "logits"
+        dtype = jnp.dtype(self.dtype)
+        maker = {"resnet18": zoo.resnet18, "resnet50": zoo.resnet50}[self.model_name]
+        model = maker(num_classes=self._num_classes, dtype=dtype, cut=cut)
+        if self._variables is None:
+            self._variables = zoo.init_resnet(
+                model, (self.image_height, self.image_width, 3), self._seed)
+        apply_fn = lambda variables, xb: model.apply(variables, xb)
+        self._dnn = DNNModel(apply_fn=apply_fn, params=self._variables,
+                             input_col="__img_in", output_col=self.output_col,
+                             batch_size=self.batch_size)
+
+    def _transform(self, t: Table) -> Table:
+        if self._dnn is None:
+            self._build()
+        imgs = _to_batch(t[self.input_col])
+        if imgs.shape[1:3] != (self.image_height, self.image_width):
+            rt = ResizeImageTransformer(input_col=self.input_col,
+                                        output_col="__img_r",
+                                        height=self.image_height,
+                                        width=self.image_width)
+            imgs = _to_batch(rt.transform(t)["__img_r"])
+        x = imgs.astype(np.float32) * self.scale
+        inner = Table({"__img_in": x})
+        out = self._dnn.transform(inner)
+        return t.with_column(self.output_col, out[self.output_col])

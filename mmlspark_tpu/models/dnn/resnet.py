@@ -1,0 +1,194 @@
+"""Flax ResNet family — the model zoo backing ImageFeaturizer.
+
+The reference ships pre-trained CNTK graphs through ModelDownloader and
+evaluates them with CNTKModel (reference: image/ImageFeaturizer.scala:40-215,
+downloader/ModelDownloader.scala). TPU-native equivalent: the standard
+ResNet-v1 architecture (He et al. 2015) in flax.linen, bfloat16-friendly,
+NHWC layout for TPU conv efficiency, with a `cut` output letting
+ImageFeaturizer take the pooled features instead of logits
+(cutOutputLayers, ImageFeaturizer.scala:100-108).
+
+`load_torch_state_dict` maps torchvision-convention checkpoint names onto
+these modules so publicly distributed weights can be imported offline —
+the ModelDownloader story without egress.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.stride, self.stride),
+                    padding=[(1, 1), (1, 1)], use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                         name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                         name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               (self.stride, self.stride), use_bias=False,
+                               dtype=self.dtype, name="downsample_conv")(residual)
+            residual = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                                    name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                         name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), (self.stride, self.stride),
+                    padding=[(1, 1), (1, 1)], use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                         name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv3")(y)
+        y = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                         name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1),
+                               (self.stride, self.stride), use_bias=False,
+                               dtype=self.dtype, name="downsample_conv")(residual)
+            residual = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                                    name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet-v1. `cut='features'` returns pooled features (the
+    ImageFeaturizer layer-cut); 'logits' returns class scores."""
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+    cut: str = "logits"          # logits | features
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.num_filters, (7, 7), (2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype,
+                         name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.num_filters * 2 ** i, stride,
+                                   dtype=self.dtype,
+                                   name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool -> (N, C)
+        if self.cut == "features":
+            return x.astype(jnp.float32)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes: int = 1000, dtype=jnp.float32, cut="logits") -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock,
+                  num_classes=num_classes, dtype=dtype, cut=cut)
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.float32, cut="logits") -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype, cut=cut)
+
+
+def init_resnet(model: ResNet, image_shape=(224, 224, 3), seed: int = 0):
+    """Random-init variables (offline stand-in for downloaded weights)."""
+    import jax
+    rng = jax.random.PRNGKey(seed)
+    return model.init(rng, jnp.zeros((1, *image_shape), model.dtype))
+
+
+def load_torch_state_dict(model: ResNet, state_dict: dict,
+                          image_shape=(224, 224, 3)):
+    """Map a torchvision-convention ResNet state_dict (OIHW convs, NCHW)
+    onto this flax module's variables (HWIO convs, NHWC)."""
+    import jax
+    variables = init_resnet(model, image_shape)
+    params = jax.tree_util.tree_map(np.asarray, variables)
+    flat = _flatten(params)
+
+    def torch_key(fk: tuple) -> str:
+        # ('params','stage0_block1','conv1','kernel') -> 'layer1.1.conv1.weight'
+        col, *path = fk
+        name = ".".join(path)
+        name = name.replace("conv_init.kernel", "conv1.weight")
+        for i in range(4):
+            name = name.replace(f"stage{i}_block", f"layer{i+1}.")
+        name = (name.replace("downsample_conv.kernel", "downsample.0.weight")
+                    .replace("head.kernel", "fc.weight")
+                    .replace("head.bias", "fc.bias")
+                    .replace(".kernel", ".weight")
+                    .replace(".scale", ".weight"))  # BN gamma
+        if col == "batch_stats":
+            name = (name.replace(".mean", ".running_mean")
+                        .replace(".var", ".running_var"))
+        name = (name.replace("bn_init", "bn1")
+                    .replace("downsample_bn", "downsample.1"))
+        name = name.replace("..", ".")
+        return name
+
+    out = {}
+    for fk, v in flat.items():
+        tk = torch_key(fk)
+        if tk not in state_dict:
+            raise KeyError(f"no torch weight for {fk} (looked for {tk!r})")
+        w = np.asarray(state_dict[tk])
+        if fk[-1] == "kernel" and w.ndim == 4:
+            w = w.transpose(2, 3, 1, 0)      # OIHW -> HWIO
+        elif fk[-1] == "kernel" and w.ndim == 2:
+            w = w.T
+        if w.shape != v.shape:
+            raise ValueError(f"{fk}: torch {w.shape} vs flax {v.shape}")
+        out[fk] = w.astype(v.dtype)
+    return _unflatten(out)
+
+
+def _flatten(tree, prefix=()):
+    flat = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            flat.update(_flatten(v, prefix + (k,)))
+    else:
+        flat[prefix] = tree
+    return flat
+
+
+def _unflatten(flat):
+    out: dict = {}
+    for path, v in flat.items():
+        cur = out
+        for k in path[:-1]:
+            cur = cur.setdefault(k, {})
+        cur[path[-1]] = v
+    return out

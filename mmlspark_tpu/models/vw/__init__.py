@@ -1,0 +1,13 @@
+"""Vowpal-Wabbit-equivalent hashed online learning, TPU-native
+(reference: vw/ — SURVEY.md §2.4)."""
+from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .estimators import (VowpalWabbitClassifier, VowpalWabbitRegressor,
+                         VowpalWabbitContextualBandit,
+                         VowpalWabbitClassificationModel,
+                         VowpalWabbitRegressionModel,
+                         VowpalWabbitContextualBanditModel)
+
+__all__ = ["VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+           "VowpalWabbitClassifier", "VowpalWabbitRegressor",
+           "VowpalWabbitContextualBandit", "VowpalWabbitClassificationModel",
+           "VowpalWabbitRegressionModel", "VowpalWabbitContextualBanditModel"]

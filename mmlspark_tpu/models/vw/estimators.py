@@ -1,0 +1,205 @@
+"""VW estimator stages (reference: vw/VowpalWabbitClassifier.scala,
+VowpalWabbitRegressor.scala, VowpalWabbitContextualBandit.scala,
+vw/VowpalWabbitBaseModel.scala).
+
+The param surface mirrors the reference's VW CLI passthrough where it maps
+cleanly (num_passes, learning_rate, l1/l2, num_bits, power_t, initial_t,
+interactions); `args` free-form passthrough has no meaning without the C++
+CLI and is intentionally absent. `get_performance_statistics` returns the
+TrainingStats table (ingest/learn timers, loss — VowpalWabbitBase.scala:27-46).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core import (Estimator, Model, Param, Table, HasFeaturesCol,
+                     HasLabelCol, HasWeightCol, HasPredictionCol,
+                     HasProbabilitiesCol, one_of)
+from .featurizer import VowpalWabbitFeaturizer
+from .learner import VWParams, fit_vw, predict_vw
+
+
+class _VWParamsMixin(HasFeaturesCol, HasLabelCol, HasWeightCol,
+                     HasPredictionCol):
+    num_bits = Param("num_bits", "feature-space bits", 18)
+    num_passes = Param("num_passes", "passes over the data", 1)
+    learning_rate = Param("learning_rate", "SGD learning rate", 0.5)
+    power_t = Param("power_t", "lr decay exponent", 0.5)
+    initial_t = Param("initial_t", "lr schedule offset", 0.0)
+    l1 = Param("l1", "L1 regularization", 0.0)
+    l2 = Param("l2", "L2 regularization", 0.0)
+    mode = Param("mode", "sgd|adaptive|bfgs (VW --adaptive / --bfgs)", "sgd",
+                 validator=one_of("sgd", "adaptive", "bfgs"))
+    batch_size = Param("batch_size", "minibatch size (1 = exact VW serial)", 256)
+    bfgs_iters = Param("bfgs_iters", "L-BFGS iterations", 25)
+    num_tasks = Param("num_tasks", "worker count (0 = all mesh devices)", 0)
+    seed = Param("seed", "shuffle seed", 0)
+    initial_model = Param("initial_model", "(weights, bias) warm start", None,
+                          transient=True)
+
+    def _vw_params(self, loss: str) -> VWParams:
+        return VWParams(num_bits=self.num_bits, loss_function=loss,
+                        learning_rate=self.learning_rate, power_t=self.power_t,
+                        initial_t=self.initial_t, l1=self.l1, l2=self.l2,
+                        num_passes=self.num_passes, batch_size=self.batch_size,
+                        mode=self.mode, bfgs_iters=self.bfgs_iters,
+                        seed=self.seed)
+
+    def _features(self, t: Table):
+        fc = self.features_col
+        if f"{fc}_idx" in t:
+            return np.asarray(t[f"{fc}_idx"]), np.asarray(t[f"{fc}_val"])
+        # dense features: treat each column slot as its own hashed feature
+        x = np.asarray(t[fc], np.float32)
+        if x.ndim != 2:
+            x = x.reshape(len(t), -1)
+        feat = VowpalWabbitFeaturizer(input_cols=[fc], output_col="__vw",
+                                      num_bits=self.num_bits)
+        out = feat.transform(Table({fc: x}))
+        return np.asarray(out["__vw_idx"]), np.asarray(out["__vw_val"])
+
+
+class _VWModelBase(Model, HasFeaturesCol, HasPredictionCol):
+    num_bits = Param("num_bits", "feature-space bits", 18)
+
+    def __init__(self, weights=None, bias: float = 0.0, stats: Optional[dict] = None,
+                 **kw):
+        super().__init__(**kw)
+        self._weights = weights
+        self._bias = bias
+        self._stats = stats or {}
+
+    def _get_state(self):
+        import json
+        return {"weights": np.asarray(self._weights),
+                "bias": np.float64(self._bias),
+                "stats": json.dumps(self._stats)}
+
+    def _set_state(self, s):
+        import json
+        self._weights = np.asarray(s["weights"])
+        self._bias = float(np.asarray(s["bias"]))
+        raw = s.get("stats")
+        self._stats = json.loads(raw) if isinstance(raw, str) else {}
+
+    def get_performance_statistics(self) -> Table:
+        """reference: VowpalWabbitBaseModel.getPerformanceStatistics"""
+        keys = sorted(self._stats)
+        return Table({k: np.asarray([self._stats[k]]
+                                    if not isinstance(self._stats[k], list)
+                                    else [self._stats[k][-1]])
+                      for k in keys})
+
+    def _features(self, t: Table):
+        return _VWParamsMixin._features(self, t)
+
+
+class VowpalWabbitRegressor(Estimator, _VWParamsMixin):
+    def _fit(self, t: Table) -> "VowpalWabbitRegressionModel":
+        idx, val = self._features(t)
+        y = np.asarray(t[self.label_col], np.float32)
+        w = (np.asarray(t[self.weight_col], np.float32)
+             if self.weight_col and self.weight_col in t else None)
+        weights, bias, stats = fit_vw(idx, val, y, self._vw_params("squared"),
+                                      weights=w,
+                                      initial_model=self.initial_model,
+                                      num_tasks=self.num_tasks)
+        return VowpalWabbitRegressionModel(
+            weights=weights, bias=bias, stats=stats,
+            features_col=self.features_col, prediction_col=self.prediction_col,
+            num_bits=self.num_bits)
+
+
+class VowpalWabbitRegressionModel(_VWModelBase):
+    def _transform(self, t: Table) -> Table:
+        idx, val = self._features(t)
+        pred = predict_vw(self._weights, self._bias, idx, val)
+        return t.with_column(self.prediction_col, pred.astype(np.float64))
+
+
+class VowpalWabbitClassifier(Estimator, _VWParamsMixin, HasProbabilitiesCol):
+    """Binary classifier with --loss_function logistic --link logistic."""
+
+    def _fit(self, t: Table) -> "VowpalWabbitClassificationModel":
+        idx, val = self._features(t)
+        y = np.asarray(t[self.label_col], np.float32)
+        w = (np.asarray(t[self.weight_col], np.float32)
+             if self.weight_col and self.weight_col in t else None)
+        weights, bias, stats = fit_vw(idx, val, y, self._vw_params("logistic"),
+                                      weights=w,
+                                      initial_model=self.initial_model,
+                                      num_tasks=self.num_tasks)
+        return VowpalWabbitClassificationModel(
+            weights=weights, bias=bias, stats=stats,
+            features_col=self.features_col, prediction_col=self.prediction_col,
+            probabilities_col=self.probabilities_col, num_bits=self.num_bits)
+
+
+class VowpalWabbitClassificationModel(_VWModelBase, HasProbabilitiesCol):
+    def _transform(self, t: Table) -> Table:
+        idx, val = self._features(t)
+        p1 = predict_vw(self._weights, self._bias, idx, val, link="logistic")
+        proba = np.stack([1 - p1, p1], axis=1)
+        return (t.with_column(self.probabilities_col, proba)
+                 .with_column(self.prediction_col,
+                              (p1 > 0.5).astype(np.float64)))
+
+
+class VowpalWabbitContextualBandit(Estimator, _VWParamsMixin):
+    """IPS-weighted contextual-bandit cost regression (reference:
+    vw/VowpalWabbitContextualBandit.scala:374 — cb_adf style with shared +
+    per-action features).
+
+    Expects columns: features (shared context), `chosen_action_col` (1-based
+    int like VW), `cost_col` (a.k.a. label), `probability_col` (logging
+    propensity). Trains a cost model on (context, action) pairs weighted by
+    1/probability; scoring emits per-action predicted costs.
+    """
+    num_actions = Param("num_actions", "action count", 2)
+    chosen_action_col = Param("chosen_action_col", "1-based chosen action", "chosen_action")
+    cost_col = Param("cost_col", "observed cost of the chosen action", "cost")
+    probability_col = Param("probability_col", "logging propensity", "probability")
+
+    def _fit(self, t: Table) -> "VowpalWabbitContextualBanditModel":
+        idx, val = self._features(t)
+        action = np.asarray(t[self.chosen_action_col]).astype(int) - 1
+        cost = np.asarray(t[self.cost_col], np.float32)
+        prob = np.clip(np.asarray(t[self.probability_col], np.float32),
+                       1e-3, 1.0)
+        # action-crossed feature space: offset hashed indices per action so
+        # each action learns its own slice (VW's per-action namespaces)
+        mask = (1 << self.num_bits) - 1
+        a_idx = ((idx.astype(np.int64) * 31 + (action[:, None] + 1) * 0x9E3779B9)
+                 & mask).astype(np.int32)
+        weights, bias, stats = fit_vw(
+            a_idx, val, cost, self._vw_params("squared"),
+            weights=1.0 / prob, num_tasks=self.num_tasks)
+        # IPS / SNIPS diagnostics (TrainingStats ipsEstimate/snipsEstimate)
+        ips_terms = cost / prob
+        stats["ips_estimate"] = float(np.mean(ips_terms))
+        stats["snips_estimate"] = float(ips_terms.sum() / max((1 / prob).sum(), 1e-9))
+        m = VowpalWabbitContextualBanditModel(
+            weights=weights, bias=bias, stats=stats,
+            features_col=self.features_col, prediction_col=self.prediction_col,
+            num_bits=self.num_bits)
+        m.set(num_actions=self.num_actions)
+        return m
+
+
+class VowpalWabbitContextualBanditModel(_VWModelBase):
+    num_actions = Param("num_actions", "action count", 2)
+
+    def _transform(self, t: Table) -> Table:
+        idx, val = self._features(t)
+        mask = (1 << self.num_bits) - 1
+        scores = []
+        for a in range(self.num_actions):
+            a_idx = ((idx.astype(np.int64) * 31 + (a + 1) * 0x9E3779B9)
+                     & mask).astype(np.int32)
+            scores.append(predict_vw(self._weights, self._bias, a_idx, val))
+        score_mat = np.stack(scores, axis=1)  # (n, A) predicted costs
+        return (t.with_column("action_scores", score_mat)
+                 .with_column(self.prediction_col,
+                              score_mat.argmin(axis=1).astype(np.float64) + 1))

@@ -1,0 +1,6 @@
+"""Utility pipeline stages (reference: stages/ — SURVEY.md §2.8)."""
+from .batching import (DynamicMiniBatchTransformer, FixedMiniBatchTransformer,
+                       FlattenBatch, TimeIntervalMiniBatchTransformer)
+
+__all__ = ["DynamicMiniBatchTransformer", "FixedMiniBatchTransformer",
+           "FlattenBatch", "TimeIntervalMiniBatchTransformer"]

@@ -1,0 +1,103 @@
+"""Mini-batching transformers (reference: stages/MiniBatchTransformer.scala:16-225,
+Batchers.scala:1-152): rows -> batch rows whose columns hold stacked arrays,
+and the FlattenBatch inverse. Batching is what turns row streams into
+MXU-shaped work for deep-net inference (CNTKModel batches with
+FixedMiniBatchTransformer by default, cntk/CNTKModel.scala:377) and what
+bounds latency for serving (DynamicMiniBatchTransformer drains whatever is
+available up to a max).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..core import Param, Table, Transformer
+from ..core.params import in_range
+
+
+def _stack_rows(col: np.ndarray, bounds) -> np.ndarray:
+    out = np.empty(len(bounds), dtype=object)
+    for i, (lo, hi) in enumerate(bounds):
+        out[i] = col[lo:hi]
+    return out
+
+
+class _BatcherBase(Transformer):
+    def _bounds(self, n: int) -> list:
+        raise NotImplementedError
+
+    def _transform(self, t: Table) -> Table:
+        bounds = self._bounds(len(t))
+        return Table({name: _stack_rows(np.asarray(t[name]), bounds)
+                      for name in t.columns}, t.npartitions)
+
+
+class FixedMiniBatchTransformer(_BatcherBase):
+    """Fixed-size batches (reference: FixedMiniBatchTransformer; buffered
+    producer-thread mode is meaningless on a columnar Table and is omitted)."""
+    batch_size = Param("batch_size", "rows per batch", 10,
+                       validator=in_range(1))
+
+    def _bounds(self, n: int) -> list:
+        b = self.batch_size
+        return [(i, min(i + b, n)) for i in range(0, n, b)]
+
+
+class DynamicMiniBatchTransformer(_BatcherBase):
+    """Drain-available batching (reference: DynamicMiniBatchTransformer):
+    over a static Table all rows are 'available', so this equals one batch
+    capped at max_batch_size — the latency-adaptive behavior lives in the
+    serving path (ServingQuery.max_batch)."""
+    max_batch_size = Param("max_batch_size", "max rows per batch", 1 << 30)
+
+    def _bounds(self, n: int) -> list:
+        b = min(self.max_batch_size, max(n, 1))
+        return [(i, min(i + b, n)) for i in range(0, n, b)]
+
+
+class TimeIntervalMiniBatchTransformer(_BatcherBase):
+    """Batch rows arriving within a time window (reference:
+    TimeIntervalMiniBatchTransformer). A static Table carries no arrival
+    times unless a `timestamp_col` provides them; rows are then grouped into
+    `interval_ms` windows."""
+    interval_ms = Param("interval_ms", "window length in ms", 1000)
+    timestamp_col = Param("timestamp_col", "epoch-seconds column (float)", None)
+    max_batch_size = Param("max_batch_size", "cap per batch", 1 << 30)
+
+    def _transform(self, t: Table) -> Table:
+        if self.timestamp_col is None or self.timestamp_col not in t:
+            return DynamicMiniBatchTransformer(
+                max_batch_size=self.max_batch_size).transform(t)
+        ts = np.asarray(t[self.timestamp_col], np.float64)
+        window = np.floor((ts - ts.min()) / (self.interval_ms / 1000.0))
+        bounds = []
+        start = 0
+        for i in range(1, len(ts) + 1):
+            boundary = (i == len(ts) or window[i] != window[start]
+                        or i - start >= self.max_batch_size)
+            if boundary:
+                bounds.append((start, i))
+                start = i
+        data = {name: _stack_rows(np.asarray(t[name]), bounds)
+                for name in t.columns}
+        return Table(data, t.npartitions)
+
+
+class FlattenBatch(Transformer):
+    """Inverse of the batchers (reference: FlattenBatch,
+    MiniBatchTransformer.scala:16-42): object rows of stacked arrays ->
+    plain rows again."""
+
+    def _transform(self, t: Table) -> Table:
+        out = {}
+        for name in t.columns:
+            col = t[name]
+            if col.dtype == object and len(col) and isinstance(
+                    col[0], np.ndarray):
+                flat = np.concatenate([np.asarray(v) for v in col])
+            else:
+                flat = col
+            out[name] = flat
+        return Table(out, t.npartitions)

@@ -1,0 +1,251 @@
+"""Deep-net inference bridge + image ops + mini-batching suites (mirror
+reference CNTKModelSuite, ImageFeaturizerSuite, UnrollImageSuite,
+ImageTransformerSuite, MiniBatchTransformerSuite)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.stages import (DynamicMiniBatchTransformer,
+                                 FixedMiniBatchTransformer, FlattenBatch,
+                                 TimeIntervalMiniBatchTransformer)
+from mmlspark_tpu.models.dnn import (DNNModel, ImageFeaturizer, resnet18,
+                                     resnet50)
+from mmlspark_tpu.models.dnn.resnet import (init_resnet, load_torch_state_dict,
+                                            _flatten)
+from mmlspark_tpu.image import (ImageSetAugmenter, ImageTransformer,
+                                ResizeImageTransformer, UnrollImage,
+                                read_image_dir)
+from mmlspark_tpu.downloader import LocalRepo, ModelSchema
+
+from fuzzing import fuzz_transformer
+
+FUZZ_COVERED = ["DNNModel", "ImageFeaturizer"]
+
+
+# ------------------------------------------------------------- mini-batching
+def test_fixed_minibatch_and_flatten():
+    t = Table({"x": np.arange(25).astype(np.float32),
+               "y": np.arange(25).astype(np.float32) * 2})
+    batched = FixedMiniBatchTransformer(batch_size=10).transform(t)
+    assert len(batched) == 3
+    assert batched["x"][0].shape == (10,) and batched["x"][2].shape == (5,)
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_array_equal(flat["x"], t["x"])
+    np.testing.assert_array_equal(flat["y"], t["y"])
+    fuzz_transformer(FixedMiniBatchTransformer(batch_size=4), t, rtol=np.inf)
+    fuzz_transformer(FlattenBatch(), batched)
+
+
+def test_dynamic_minibatch():
+    t = Table({"x": np.arange(10).astype(np.float32)})
+    out = DynamicMiniBatchTransformer().transform(t)
+    assert len(out) == 1 and out["x"][0].shape == (10,)
+    out2 = DynamicMiniBatchTransformer(max_batch_size=4).transform(t)
+    assert len(out2) == 3
+    fuzz_transformer(DynamicMiniBatchTransformer(max_batch_size=4), t,
+                     rtol=np.inf)
+
+
+def test_time_interval_minibatch():
+    ts = np.asarray([0.0, 0.1, 0.2, 1.5, 1.6, 3.0])
+    t = Table({"x": np.arange(6).astype(np.float32), "ts": ts})
+    out = TimeIntervalMiniBatchTransformer(
+        interval_ms=1000, timestamp_col="ts").transform(t)
+    assert [len(v) for v in out["x"]] == [3, 2, 1]
+    fuzz_transformer(TimeIntervalMiniBatchTransformer(
+        interval_ms=1000, timestamp_col="ts"), t, rtol=np.inf)
+
+
+# ------------------------------------------------------------- DNNModel
+def _mlp():
+    import jax.numpy as jnp
+    params = {"w": np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32),
+              "b": np.zeros(3, np.float32)}
+
+    def apply_fn(p, xb):
+        return jnp.tanh(xb @ p["w"] + p["b"])
+
+    return apply_fn, params
+
+
+def test_dnn_model_minibatch_eval():
+    apply_fn, params = _mlp()
+    x = np.random.default_rng(1).normal(size=(37, 8)).astype(np.float32)
+    t = Table({"features": x})
+    m = DNNModel(apply_fn=apply_fn, params=params, batch_size=16,
+                 output_col="scores")
+    out = m.transform(t)
+    assert out["scores"].shape == (37, 3)  # ragged last batch unpadded
+    expected = np.tanh(x @ params["w"] + params["b"])
+    np.testing.assert_allclose(out["scores"], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_dnn_model_save_load_stablehlo(tmp_path):
+    """Model round-trips as params + StableHLO bytes; the loaded model needs
+    NO python apply_fn — the graph came from the artifact (CNTK
+    protobuf-bytes equivalent)."""
+    apply_fn, params = _mlp()
+    x = np.random.default_rng(2).normal(size=(16, 8)).astype(np.float32)
+    t = Table({"features": x})
+    m = DNNModel(apply_fn=apply_fn, params=params, batch_size=16)
+    out1 = m.transform(t)
+    m.save(str(tmp_path / "dnn"))
+    m2 = DNNModel.load(str(tmp_path / "dnn"))
+    assert m2._apply_fn is None  # scoring must come from StableHLO
+    out2 = m2.transform(t)
+    np.testing.assert_allclose(out1["scores"], out2["scores"], rtol=1e-6)
+
+
+# ------------------------------------------------------------- image ops
+@pytest.fixture(scope="module")
+def cifar_batch():
+    rng = np.random.default_rng(3)
+    return rng.integers(0, 256, size=(6, 32, 32, 3)).astype(np.uint8)
+
+
+def test_resize(cifar_batch):
+    t = Table({"image": cifar_batch})
+    out = ResizeImageTransformer(height=16, width=24).transform(t)
+    assert out["image"].shape == (6, 16, 24, 3)
+
+
+def test_unroll_chw_bgr(cifar_batch):
+    t = Table({"image": cifar_batch[:2]})
+    out = UnrollImage(scale=1.0).transform(t)
+    vec = out["features"]
+    assert vec.shape == (2, 3 * 32 * 32)
+    # CHW order with BGR: first H*W block is the blue channel
+    np.testing.assert_allclose(vec[0, :32 * 32],
+                               cifar_batch[0, :, :, 2].reshape(-1))
+
+
+def test_augmenter(cifar_batch):
+    t = Table({"image": cifar_batch, "label": np.arange(6).astype(np.float32)})
+    out = ImageSetAugmenter(flip_left_right=True,
+                            flip_up_down=True).transform(t)
+    assert len(out) == 18
+    np.testing.assert_array_equal(out["image"][6], cifar_batch[0][:, ::-1])
+    np.testing.assert_array_equal(out["image"][12], cifar_batch[0][::-1])
+
+
+def test_image_transformer_dsl(cifar_batch):
+    t = Table({"image": cifar_batch})
+    it = (ImageTransformer().resize(24, 24).center_crop(20, 20)
+          .flip(1).blur(3, 3))
+    out = it.transform(t)
+    assert out["image"].shape == (6, 20, 20, 3)
+    gray = ImageTransformer().color_format("gray").transform(t)
+    assert gray["image"].shape == (6, 32, 32)
+    fuzz_transformer(it, t)
+
+
+def test_read_image_dir(tmp_path):
+    from PIL import Image
+    for i in range(3):
+        Image.fromarray(np.full((8, 8, 3), i * 40, np.uint8)).save(
+            tmp_path / f"img{i}.png")
+    (tmp_path / "notes.txt").write_text("not an image")
+    t = read_image_dir(str(tmp_path))
+    assert len(t) == 3  # dropInvalid skipped the txt
+    assert t["image"].shape == (3, 8, 8, 3)
+
+
+# ------------------------------------------------------------- resnet zoo
+def test_resnet18_shapes(cifar_batch):
+    import jax.numpy as jnp
+    model = resnet18(num_classes=10)
+    variables = init_resnet(model, (32, 32, 3))
+    out = model.apply(variables, jnp.asarray(cifar_batch, jnp.float32) / 255.0)
+    assert out.shape == (6, 10)
+    feat_model = resnet18(num_classes=10, cut="features")
+    feats = feat_model.apply(variables, jnp.asarray(cifar_batch, jnp.float32))
+    assert feats.shape == (6, 512)
+
+
+def test_torch_state_dict_mapping():
+    """Round-trip: our variables -> torch-convention dict -> loaded back
+    must be identical (validates the name/axis mapping is a bijection)."""
+    from mmlspark_tpu.models.dnn.resnet import load_torch_state_dict
+    import mmlspark_tpu.models.dnn.resnet as rn
+    model = resnet18(num_classes=7)
+    variables = init_resnet(model, (32, 32, 3))
+    flat = rn._flatten({k: dict(v) if hasattr(v, "items") else v
+                        for k, v in variables.items()})
+    # build a torch-style state dict by inverting the documented mapping
+    sd = {}
+    import numpy as np
+    for fk, v in rn._flatten(variables).items():
+        # reuse the module's own key mapping by calling through a probe
+        pass
+    # easier: construct via the loader's error paths — generate names with
+    # the same function the loader uses
+    from mmlspark_tpu.models.dnn import resnet as zoo
+    probe = {}
+    def torch_key(fk):
+        col, *path = fk
+        name = ".".join(path)
+        name = name.replace("conv_init.kernel", "conv1.weight")
+        for i in range(4):
+            name = name.replace(f"stage{i}_block", f"layer{i+1}.")
+        name = (name.replace("downsample_conv.kernel", "downsample.0.weight")
+                    .replace("head.kernel", "fc.weight")
+                    .replace("head.bias", "fc.bias")
+                    .replace(".kernel", ".weight")
+                    .replace(".scale", ".weight"))
+        if col == "batch_stats":
+            name = (name.replace(".mean", ".running_mean")
+                        .replace(".var", ".running_var"))
+        name = (name.replace("bn_init", "bn1")
+                    .replace("downsample_bn", "downsample.1"))
+        return name.replace("..", ".")
+    for fk, v in zoo._flatten(variables).items():
+        w = np.asarray(v)
+        if fk[-1] == "kernel" and w.ndim == 4:
+            w = w.transpose(3, 2, 0, 1)
+        elif fk[-1] == "kernel" and w.ndim == 2:
+            w = w.T
+        sd[torch_key(fk)] = w
+    loaded = load_torch_state_dict(model, sd, (32, 32, 3))
+    for fk, v in zoo._flatten(variables).items():
+        np.testing.assert_array_equal(zoo._flatten(loaded)[fk], np.asarray(v),
+                                      err_msg=str(fk))
+
+
+# ------------------------------------------------------------- featurizer
+def test_image_featurizer(cifar_batch, tmp_path):
+    t = Table({"image": cifar_batch,
+               "label": np.arange(6).astype(np.float32)})
+    f = ImageFeaturizer(model_name="resnet18", input_col="image",
+                        output_col="features", image_height=32,
+                        image_width=32, batch_size=4, dtype="float32",
+                        num_classes=10)
+    out = f.transform(t)
+    assert out["features"].shape == (6, 512)  # head cut -> pooled features
+    # full head
+    f2 = ImageFeaturizer(model_name="resnet18", cut_output_layers=0,
+                         image_height=32, image_width=32, dtype="float32",
+                         num_classes=10)
+    f2._variables = f._variables
+    out2 = f2.transform(t)
+    assert out2["features"].shape == (6, 10)
+    # persistence round-trip
+    f.save(str(tmp_path / "feat"))
+    f3 = ImageFeaturizer.load(str(tmp_path / "feat"))
+    out3 = f3.transform(t)
+    np.testing.assert_allclose(out3["features"], out["features"], rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_model_downloader_roundtrip(tmp_path):
+    repo = LocalRepo(str(tmp_path / "repo"))
+    model = resnet18(num_classes=5)
+    variables = init_resnet(model, (32, 32, 3))
+    repo.put_model(ModelSchema(name="resnet18", input_shape=(32, 32, 3),
+                               num_classes=5, variables=variables))
+    assert [s.name for s in repo.list_models()] == ["resnet18"]
+    got = repo.get_model("resnet18")
+    assert got.variables is not None
+    f = ImageFeaturizer(image_height=32, image_width=32, dtype="float32",
+                        num_classes=5).set_model(got)
+    out = f.transform(Table({"image": np.zeros((2, 32, 32, 3), np.uint8)}))
+    assert out["features"].shape == (2, 512)
