@@ -77,8 +77,11 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
         maker = {"resnet18": zoo.resnet18, "resnet50": zoo.resnet50}[self.model_name]
         model = maker(num_classes=self._num_classes, dtype=dtype, cut=cut)
         if self._variables is None:
+            # Always init the FULL model (head included) so the same variables
+            # serve both cut settings (layer-cut only changes apply, not state).
+            full = maker(num_classes=self._num_classes, dtype=dtype, cut="logits")
             self._variables = zoo.init_resnet(
-                model, (self.image_height, self.image_width, 3), self._seed)
+                full, (self.image_height, self.image_width, 3), self._seed)
         apply_fn = lambda variables, xb: model.apply(variables, xb)
         self._dnn = DNNModel(apply_fn=apply_fn, params=self._variables,
                              input_col="__img_in", output_col=self.output_col,

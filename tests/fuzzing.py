@@ -21,7 +21,14 @@ def assert_tables_equal(a: Table, b: Table, rtol=1e-5, atol=1e-6, cols=None):
         if np.issubdtype(ca.dtype, np.number):
             np.testing.assert_allclose(ca, cb, rtol=rtol, atol=atol, err_msg=f"col {n}")
         else:
-            assert list(ca) == list(cb), f"col {n} mismatch"
+            for i, (va, vb) in enumerate(zip(ca, cb)):
+                if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+                    np.testing.assert_allclose(
+                        np.asarray(va, dtype=np.float64),
+                        np.asarray(vb, dtype=np.float64),
+                        rtol=rtol, atol=atol, err_msg=f"col {n} row {i}")
+                else:
+                    assert va == vb, f"col {n} row {i}: {va!r} != {vb!r}"
 
 
 def roundtrip(stage):

@@ -19,7 +19,7 @@ from mmlspark_tpu.downloader import LocalRepo, ModelSchema
 
 from fuzzing import fuzz_transformer
 
-FUZZ_COVERED = ["DNNModel", "ImageFeaturizer"]
+FUZZ_COVERED = ["DNNModel", "ImageFeaturizer", "ImageTransformer"]
 
 
 # ------------------------------------------------------------- mini-batching
@@ -107,6 +107,7 @@ def test_resize(cifar_batch):
     t = Table({"image": cifar_batch})
     out = ResizeImageTransformer(height=16, width=24).transform(t)
     assert out["image"].shape == (6, 16, 24, 3)
+    fuzz_transformer(ResizeImageTransformer(height=16, width=24), t)
 
 
 def test_unroll_chw_bgr(cifar_batch):
@@ -117,6 +118,7 @@ def test_unroll_chw_bgr(cifar_batch):
     # CHW order with BGR: first H*W block is the blue channel
     np.testing.assert_allclose(vec[0, :32 * 32],
                                cifar_batch[0, :, :, 2].reshape(-1))
+    fuzz_transformer(UnrollImage(scale=1.0), t)
 
 
 def test_augmenter(cifar_batch):
@@ -126,6 +128,7 @@ def test_augmenter(cifar_batch):
     assert len(out) == 18
     np.testing.assert_array_equal(out["image"][6], cifar_batch[0][:, ::-1])
     np.testing.assert_array_equal(out["image"][12], cifar_batch[0][::-1])
+    fuzz_transformer(ImageSetAugmenter(flip_left_right=True), t)
 
 
 def test_image_transformer_dsl(cifar_batch):
