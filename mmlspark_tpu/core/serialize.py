@@ -91,8 +91,109 @@ def _encode_value(value, slot: str, path: str, arrays: dict) -> dict:
                           for i, v in enumerate(value)]}
     if isinstance(value, (np.integer, np.floating, np.bool_)):
         return {"kind": "json", "value": value.item()}
+    if callable(value) and not isinstance(value, type):
+        # UDF-style callables. Preferred encoding is by qualified name (safe:
+        # load resolves an attribute, it never executes embedded bytecode) —
+        # works for any module-level function, like Spark referencing a UDF
+        # class by name. Closures/lambdas need pickle, which runs arbitrary
+        # code at LOAD time, so both directions are gated behind
+        # MMLSPARK_TPU_PICKLE_UDFS=1; otherwise mark the param transient.
+        named = _named_fn_spec(value)
+        if named is not None:
+            return named
+        if os.environ.get("MMLSPARK_TPU_PICKLE_UDFS") == "1":
+            import base64
+            import pickle
+            try:
+                payload = pickle.dumps(value)
+            except Exception as e:
+                raise TypeError(
+                    f"callable param cannot be pickled ({e}); use a "
+                    f"module-level function or mark the param transient") from e
+            return {"kind": "pickled_fn",
+                    "data": base64.b64encode(payload).decode("ascii")}
+        hint = ("functions defined in __main__ (a script/notebook) cannot be "
+                "resolved by other processes; move the function into an "
+                "importable module"
+                if getattr(value, "__module__", None) == "__main__" else
+                "define it at module scope")
+        raise TypeError(
+            f"callable param is not an importable module-level function; "
+            f"{hint}, mark the param transient, or opt into pickling with "
+            f"MMLSPARK_TPU_PICKLE_UDFS=1 (pickle also resolves by module + "
+            f"name, so __main__ functions still only load from the same "
+            f"script)")
     json.dumps(value)  # raises TypeError for anything we can't persist
     return {"kind": "json", "value": value}
+
+
+def _named_fn_spec(fn):
+    """{"kind": "named_fn"} spec if fn is importable by module + qualname
+    (verified by actually resolving it back to the same object)."""
+    import importlib
+    import types
+    if not isinstance(fn, (types.FunctionType, np.ufunc)):
+        return None  # load applies the same shape check; stay symmetric
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", None) or getattr(fn, "__name__", None)
+    if not qual or "<" in qual:  # <lambda>, <locals> closures
+        return None
+    if mod == "__main__":
+        # '__main__' names a DIFFERENT module in every loading process — the
+        # save-time identity check below would pass here but resolve to a
+        # missing/different function elsewhere. Force the pickle opt-in path.
+        return None
+    # numpy ufuncs (np.log1p, ...) carry no __module__ but live on numpy
+    for candidate in ([mod] if mod else []) + ["numpy"]:
+        try:
+            obj = importlib.import_module(candidate)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+        except (ImportError, AttributeError):
+            continue
+        if obj is fn:
+            return {"kind": "named_fn", "module": candidate, "qualname": qual}
+    return None
+
+
+# modules whose attributes are never legitimate UDFs; a tampered artifact
+# naming e.g. os.system or subprocess.call must not resolve
+_NAMED_FN_DENYLIST = frozenset({
+    "os", "subprocess", "shutil", "sys", "pty", "socket", "pickle",
+    "ctypes", "importlib", "builtins", "posix", "nt", "shlex", "runpy",
+    "code", "codeop", "webbrowser",
+})
+
+
+def _resolve_named_fn(spec: dict):
+    import importlib
+    import types
+    mod = spec["module"]
+    if mod.split(".")[0] in _NAMED_FN_DENYLIST:
+        raise ValueError(
+            f"artifact names a callable from module {mod!r}, which cannot "
+            f"hold UDFs; refusing to resolve it")
+    obj = importlib.import_module(mod)
+    for part in spec["qualname"].split("."):
+        obj = getattr(obj, part)
+        if isinstance(obj, types.ModuleType):
+            # qualnames never traverse modules — walking through a module
+            # attribute (e.g. zipfile.shutil.rmtree) is a denylist bypass
+            raise ValueError(
+                f"artifact qualname {spec['qualname']!r} traverses module "
+                f"{obj.__name__!r}; refusing to resolve it")
+    fn_mod = getattr(obj, "__module__", None) or ""
+    if fn_mod.split(".")[0] in _NAMED_FN_DENYLIST:
+        raise ValueError(
+            f"artifact resolves to a callable defined in {fn_mod!r}, which "
+            f"cannot hold UDFs; refusing to use it")
+    if not isinstance(obj, (types.FunctionType, np.ufunc)):
+        # builtins / bound methods / arbitrary callables are not the shapes
+        # _named_fn_spec produces — a hand-edited artifact is the only way here
+        raise TypeError(
+            f"{mod}.{spec['qualname']} is not a plain function/ufunc; "
+            f"refusing to use it as a UDF")
+    return obj
 
 
 def _decode_value(spec: dict, path: str, arrays: dict):
@@ -112,6 +213,17 @@ def _decode_value(spec: dict, path: str, arrays: dict):
         mod, _, cname = spec["class"].rpartition(".")
         cls = getattr(importlib.import_module(mod), cname)
         return cls._from_json(spec["value"])
+    if kind == "named_fn":
+        return _resolve_named_fn(spec)
+    if kind == "pickled_fn":
+        if os.environ.get("MMLSPARK_TPU_PICKLE_UDFS") != "1":
+            raise ValueError(
+                "artifact contains a pickled callable; refusing to unpickle "
+                "without MMLSPARK_TPU_PICKLE_UDFS=1 (pickle executes "
+                "arbitrary code at load time)")
+        import base64
+        import pickle
+        return pickle.loads(base64.b64decode(spec["data"]))
     if kind == "dict":
         return {json.loads(k): _decode_value(v, path, arrays)
                 for k, v in spec["items"]}

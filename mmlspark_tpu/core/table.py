@@ -21,14 +21,27 @@ from typing import Callable, Iterable, Optional, Sequence
 import numpy as np
 
 
+def _is_device_array(x) -> bool:
+    """True for jax device arrays (checked without importing jax)."""
+    return type(x).__module__.split(".")[0] in ("jax", "jaxlib")
+
+
 class Table:
-    """Immutable ordered collection of named columns with a partition count."""
+    """Immutable ordered collection of named columns with a partition count.
+
+    Columns are host numpy arrays OR jax device arrays — device results flow
+    between stages lazily; `materialize()` is the explicit host sync.
+    """
 
     def __init__(self, data: dict, npartitions: int = 1):
         self._cols: dict[str, np.ndarray] = {}
         nrows = None
         for name, col in data.items():
-            arr = col if isinstance(col, np.ndarray) else np.asarray(col)
+            # jax device arrays are kept as-is — stages can hand results
+            # between each other without a host round-trip; materialize()
+            # is the explicit host sync point
+            arr = (col if isinstance(col, np.ndarray) or _is_device_array(col)
+                   else np.asarray(col))
             if nrows is None:
                 nrows = arr.shape[0] if arr.ndim else 0
             elif arr.shape[0] != nrows:
@@ -76,7 +89,7 @@ class Table:
 
     # -- functional updates -------------------------------------------------
     def with_column(self, name: str, col) -> "Table":
-        arr = np.asarray(col)
+        arr = col if _is_device_array(col) else np.asarray(col)
         if self._nrows and arr.shape[0] != self._nrows:
             raise ValueError(
                 f"new column {name!r} has {arr.shape[0]} rows, table has {self._nrows}")
@@ -160,6 +173,13 @@ class Table:
         a, b = perm[:k], perm[k:]
         return (Table({n: c[a] for n, c in self._cols.items()}, self.npartitions),
                 Table({n: c[b] for n, c in self._cols.items()}, self.npartitions))
+
+    def materialize(self) -> "Table":
+        """Force every column to a concrete host numpy array — the
+        materialization barrier Cacher/Timer use; jax device columns
+        transfer and sync here."""
+        return Table({n: c if isinstance(c, np.ndarray) else np.asarray(c)
+                      for n, c in self._cols.items()}, self.npartitions)
 
     # -- misc ----------------------------------------------------------------
     def find_unused_column_name(self, prefix: str) -> str:

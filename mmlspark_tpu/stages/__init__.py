@@ -1,6 +1,21 @@
 """Utility pipeline stages (reference: stages/ — SURVEY.md §2.8)."""
+from .basic import (Cacher, DropColumns, Explode, Lambda, RenameColumn,
+                    Repartition, SelectColumns, StratifiedRepartition,
+                    UDFTransformer)
 from .batching import (DynamicMiniBatchTransformer, FixedMiniBatchTransformer,
                        FlattenBatch, TimeIntervalMiniBatchTransformer)
+from .ensemble import (ClassBalancer, ClassBalancerModel, EnsembleByKey,
+                       MultiColumnAdapter)
+from .summarize import SummarizeData
+from .text_stages import TextPreprocessor, UnicodeNormalize
+from .timer import Timer, TimerModel
 
-__all__ = ["DynamicMiniBatchTransformer", "FixedMiniBatchTransformer",
-           "FlattenBatch", "TimeIntervalMiniBatchTransformer"]
+__all__ = [
+    "Cacher", "ClassBalancer", "ClassBalancerModel", "DropColumns",
+    "DynamicMiniBatchTransformer", "EnsembleByKey", "Explode",
+    "FixedMiniBatchTransformer", "FlattenBatch", "Lambda",
+    "MultiColumnAdapter", "RenameColumn", "Repartition", "SelectColumns",
+    "StratifiedRepartition", "SummarizeData", "TextPreprocessor",
+    "TimeIntervalMiniBatchTransformer", "Timer", "TimerModel",
+    "UDFTransformer", "UnicodeNormalize",
+]

@@ -1,0 +1,213 @@
+"""Utility column/row stages — the high-traffic half of the reference's stage zoo
+(reference: stages/DropColumns.scala:65, SelectColumns.scala:67, RenameColumn.scala:46,
+Repartition.scala:68, Cacher.scala:43, Explode.scala:43, UDFTransformer.scala:112,
+Lambda.scala:65, StratifiedRepartition.scala:82).
+
+Design notes (TPU-first): every stage is a whole-column transform over Table —
+no per-row UDF loops. UDFTransformer is vectorized by default: the udf receives
+the full column array(s) and returns a column, which keeps user code fusable
+when it is jax/numpy. StratifiedRepartition spreads each label evenly over the
+row order so every contiguous partition slice (partition-as-device) sees all labels —
+the property LightGBM-style training needs (reference docstring,
+StratifiedRepartition.scala:27-29).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..core import Param, Table, Transformer
+from ..core.params import HasInputCol, HasOutputCol, HasLabelCol, HasSeed, one_of
+
+
+class DropColumns(Transformer):
+    """Drop the listed columns (reference: stages/DropColumns.scala:20-65;
+    errors on absent columns like verifySchema does)."""
+    cols = Param("cols", "columns to drop", None)
+
+    def __init__(self, cols: Optional[Sequence[str]] = None, **kw):
+        super().__init__(**kw)
+        if cols is not None:
+            self.set(cols=list(cols))
+
+    def _transform(self, t: Table) -> Table:
+        missing = [c for c in (self.cols or []) if c not in t]
+        if missing:
+            raise KeyError(f"DropColumns: no such columns {missing}; have {t.columns}")
+        return t.drop(*(self.cols or []))
+
+
+class SelectColumns(Transformer):
+    """Keep only the listed columns (reference: stages/SelectColumns.scala:22-67)."""
+    cols = Param("cols", "columns to keep", None)
+
+    def __init__(self, cols: Optional[Sequence[str]] = None, **kw):
+        super().__init__(**kw)
+        if cols is not None:
+            self.set(cols=list(cols))
+
+    def _transform(self, t: Table) -> Table:
+        missing = [c for c in (self.cols or []) if c not in t]
+        if missing:
+            raise KeyError(f"SelectColumns: no such columns {missing}; have {t.columns}")
+        return t.select(list(self.cols or []))
+
+
+class RenameColumn(Transformer, HasInputCol, HasOutputCol):
+    """Rename input_col to output_col (reference: stages/RenameColumn.scala:20-46)."""
+
+    def _transform(self, t: Table) -> Table:
+        return t.rename({self.input_col: self.output_col})
+
+
+class Repartition(Transformer):
+    """Change the Table's partition count (reference: stages/Repartition.scala:21-68).
+    Partitions map to devices here, so this is the stage that re-grids work."""
+    n = Param("n", "number of partitions", 1)
+    disable = Param("disable", "pass through unchanged", False)
+
+    def _transform(self, t: Table) -> Table:
+        if self.disable:
+            return t
+        return t.repartition(self.n)
+
+
+class Cacher(Transformer):
+    """Materialization barrier (reference: stages/Cacher.scala:14-43). Columns
+    here are already host-resident numpy, so caching is forcing any lazy
+    device buffers back to host — a deliberate sync point."""
+    disable = Param("disable", "pass through unchanged", False)
+
+    def _transform(self, t: Table) -> Table:
+        if self.disable:
+            return t
+        return t.materialize()
+
+
+class Explode(Transformer, HasInputCol, HasOutputCol):
+    """Explode an array-valued column into one row per element, repeating the
+    other columns (reference: stages/Explode.scala:20-43)."""
+
+    def _transform(self, t: Table) -> Table:
+        col = t[self.input_col]
+        if col.dtype == object:
+            lengths = np.array([len(np.atleast_1d(v)) for v in col], dtype=np.int64)
+            values = (np.concatenate([np.atleast_1d(v) for v in col])
+                      if len(col) else np.empty(0))
+        elif col.ndim >= 2:
+            lengths = np.full(col.shape[0], col.shape[1], dtype=np.int64)
+            values = col.reshape(-1, *col.shape[2:])
+        else:
+            raise TypeError(
+                f"Explode: column {self.input_col!r} is scalar-valued "
+                f"(dtype={col.dtype}, ndim={col.ndim}); need arrays per row")
+        out = {}
+        for name in t.columns:
+            if name == self.input_col:
+                continue
+            out[name] = np.repeat(t[name], lengths, axis=0)
+        out[self.output_col] = values
+        return Table(out, t.npartitions)
+
+
+class UDFTransformer(Transformer, HasOutputCol):
+    """Apply a user function to one or more columns (reference:
+    stages/UDFTransformer.scala:29-112). TPU-first: the udf is VECTORIZED by
+    default — it receives whole column array(s) and returns a column, so
+    numpy/jax udfs stay fused instead of running a per-row Python loop. Set
+    vectorized=False for a scalar elementwise function."""
+    input_col = Param("input_col", "single input column", None)
+    input_cols = Param("input_cols", "multiple input columns", None)
+    udf = Param("udf", "callable column(s) -> column (saved by qualified name; pickle is opt-in)", None)
+    vectorized = Param("vectorized", "udf takes whole columns, not scalars", True)
+
+    def _transform(self, t: Table) -> Table:
+        fn = self.udf
+        if fn is None:
+            raise ValueError("UDFTransformer: udf param is not set")
+        if self.input_cols:
+            args = [t[c] for c in self.input_cols]
+        else:
+            args = [t[self.input_col or "input"]]
+        if self.vectorized:
+            # pass device arrays through untouched — with_column keeps jax
+            # results on device; forcing numpy here would desync the lazy
+            # device-column flow Table supports
+            out = fn(*args)
+        else:
+            out = np.asarray([fn(*row) for row in zip(*args)])
+        return t.with_column(self.output_col, out)
+
+
+class Lambda(Transformer):
+    """Arbitrary Table -> Table function as a pipeline stage (reference:
+    stages/Lambda.scala:19-65)."""
+    transform_fn = Param("transform_fn", "callable Table -> Table (saved by qualified name; pickle is opt-in)",
+                         None)
+
+    def __init__(self, transform_fn: Optional[Callable] = None, **kw):
+        super().__init__(**kw)
+        if transform_fn is not None:
+            self.set(transform_fn=transform_fn)
+
+    def _transform(self, t: Table) -> Table:
+        fn = self.transform_fn
+        if fn is None:
+            raise ValueError("Lambda: transform_fn param is not set")
+        out = fn(t)
+        if not isinstance(out, Table):
+            raise TypeError("Lambda transform_fn must return a Table")
+        return out
+
+
+class StratifiedRepartition(Transformer, HasLabelCol, HasSeed):
+    """Reorder (and optionally resample) rows so every partition contains every
+    label (reference: stages/StratifiedRepartition.scala:27-82). Needed when a
+    distributed learner requires each device shard to see all classes.
+
+    Modes (StratifiedRepartition.scala:53-77):
+    - 'original': keep counts, just spread each label evenly over the row order.
+    - 'equal': resample each label (with replacement) to max(count, npartitions)
+      so labels are balanced, then spread.
+    - 'mixed' (default): heuristic — upsample only labels below the mean share
+      (total/n_labels) up to that share; labels at/above it keep their counts.
+    """
+    mode = Param("mode", "equal | original | mixed", "mixed",
+                 validator=one_of("equal", "original", "mixed"))
+
+    def _transform(self, t: Table) -> Table:
+        labels = np.asarray(t[self.label_col])
+        uniq, inv, counts = np.unique(labels, return_inverse=True,
+                                      return_counts=True)
+        rng = np.random.default_rng(self.seed)
+        per_label = [np.flatnonzero(inv == k) for k in range(len(uniq))]
+
+        if self.mode == "original":
+            targets = counts
+        elif self.mode == "equal":
+            # equal share: every label resampled to the max count
+            # (getEqualLabelCount, StratifiedRepartition.scala:74-77)
+            targets = np.full_like(counts, max(int(counts.max()), t.npartitions))
+        else:  # mixed: lift only under-represented labels to the mean share
+            mean_share = max(int(np.ceil(counts.sum() / len(counts))),
+                             t.npartitions)
+            targets = np.maximum(counts, mean_share)
+
+        sampled = []
+        for idx, target in zip(per_label, targets):
+            target = int(target)
+            if target <= len(idx):
+                sampled.append(idx[:target])
+            else:
+                extra = rng.choice(idx, size=target - len(idx), replace=True)
+                sampled.append(np.concatenate([idx, extra]))
+
+        # spread each label uniformly over [0,1) by fractional rank, then sort:
+        # every contiguous partition slice gets a proportional share of every
+        # label (round-robin compaction would front-load minority labels and
+        # leave a majority-only tail)
+        keys = np.concatenate([(np.arange(len(idx)) + 0.5) / len(idx)
+                               for idx in sampled])
+        flat = np.concatenate(sampled)[np.argsort(keys, kind="stable")]
+        return Table({n: t[n][flat] for n in t.columns}, t.npartitions)

@@ -1,0 +1,159 @@
+"""EnsembleByKey + MultiColumnAdapter + ClassBalancer (reference:
+stages/EnsembleByKey.scala:22-208, MultiColumnAdapter.scala:18-135,
+ClassBalancer.scala:17-101).
+
+Group-bys are implemented with np.unique inverse indices + np.add.at
+segment sums — the same segment-reduction shape the device kernels use, so
+vector columns aggregate without materializing per-group Python lists.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core import Estimator, Model, Param, Table, Transformer
+from ..core.params import HasInputCol, HasOutputCol, one_of
+from ..core.pipeline import PipelineModel
+
+
+def _group_ids(t: Table, keys: Sequence[str]):
+    """Dense group ids + first-occurrence row per group for the key columns."""
+    if len(keys) == 1:
+        uniq, first, inv = np.unique(t[keys[0]], return_index=True,
+                                     return_inverse=True)
+        return inv, first, len(uniq)
+    # vectorized compound key: per-key dense ids composed by mixed-radix
+    # (inv = inv*base_k + inv_k) — collision-free, no per-row Python work
+    combined = np.zeros(len(t), dtype=np.int64)
+    for k in keys:
+        uniq_k, inv_k = np.unique(t[k], return_inverse=True)
+        combined = combined * len(uniq_k) + inv_k
+    uniq, first, inv = np.unique(combined, return_index=True,
+                                 return_inverse=True)
+    return inv, first, len(uniq)
+
+
+class EnsembleByKey(Transformer):
+    """Average score columns within key groups (reference:
+    stages/EnsembleByKey.scala:22-208). strategy='mean' is the only strategy
+    the reference allows (EnsembleByKey.scala:56-58). collapse_group=True
+    yields one row per group; False joins the group mean back onto each row
+    (EnsembleByKey.scala:132-146)."""
+    keys = Param("keys", "key columns to group by", None)
+    cols = Param("cols", "columns to ensemble", None)
+    col_names = Param("col_names", "output names per ensembled column", None)
+    strategy = Param("strategy", "ensembling strategy", "mean",
+                     validator=one_of("mean"))
+    collapse_group = Param("collapse_group",
+                           "collapse each group to a single row", True)
+
+    def __init__(self, keys: Optional[Sequence[str]] = None,
+                 cols: Optional[Sequence[str]] = None, **kw):
+        super().__init__(**kw)
+        if keys is not None:
+            self.set(keys=list(keys))
+        if cols is not None:
+            self.set(cols=list(cols))
+
+    def _transform(self, t: Table) -> Table:
+        keys = list(self.keys or [])
+        cols = list(self.cols or [])
+        if not keys or not cols:
+            raise ValueError("EnsembleByKey needs keys and cols")
+        names = list(self.col_names) if self.col_names else \
+            [f"{self.strategy}({c})" for c in cols]
+        if len(names) != len(cols):
+            raise ValueError(
+                f"col_names ({len(names)}) must match cols ({len(cols)})")
+        inv, first, n_groups = _group_ids(t, keys)
+        counts = np.bincount(inv, minlength=n_groups).astype(np.float64)
+
+        agg = {}
+        for c, out_name in zip(cols, names):
+            col = np.asarray(t[c], dtype=np.float64)
+            if col.ndim == 1:
+                sums = np.bincount(inv, weights=col, minlength=n_groups)
+                agg[out_name] = sums / counts
+            else:  # vector column: segment-sum each component
+                sums = np.zeros((n_groups, col.shape[1]))
+                np.add.at(sums, inv, col)
+                agg[out_name] = sums / counts[:, None]
+
+        if self.collapse_group:
+            data = {k: t[k][first] for k in keys}
+            data.update(agg)
+            return Table(data, t.npartitions)
+        return t.with_columns({name: vals[inv] for name, vals in agg.items()})
+
+
+class MultiColumnAdapter(Estimator):
+    """Fit one copy of base_stage per (input, output) column pair (reference:
+    stages/MultiColumnAdapter.scala:18-135); the fitted result is a
+    PipelineModel chaining the per-column models."""
+    base_stage = Param("base_stage", "stage to replicate per column", None)
+    input_cols = Param("input_cols", "input columns", None)
+    output_cols = Param("output_cols", "output columns", None)
+
+    def _per_column_stages(self):
+        base = self.base_stage
+        if base is None:
+            raise ValueError("MultiColumnAdapter: base_stage is not set")
+        ins, outs = list(self.input_cols or []), list(self.output_cols or [])
+        if len(ins) != len(outs):
+            raise ValueError(
+                f"input_cols ({len(ins)}) and output_cols ({len(outs)}) "
+                f"must pair up")  # MultiColumnAdapter.scala:62-66
+        return [base.copy({"input_col": i, "output_col": o})
+                for i, o in zip(ins, outs)]
+
+    def _fit(self, t: Table) -> PipelineModel:
+        fitted = []
+        current = t
+        for stage in self._per_column_stages():
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+            else:
+                model = stage
+            current = model.transform(current)
+            fitted.append(model)
+        return PipelineModel(stages=fitted)
+
+
+class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
+    """Compute inverse-frequency sample weights per label value (reference:
+    stages/ClassBalancer.scala:17-61): weight = max(count) / count."""
+    input_col = Param("input_col", "label column", "label")
+    output_col = Param("output_col", "weight column", "weight")
+    broadcast_join = Param("broadcast_join",
+                           "broadcast the weight map (API parity; the map is "
+                           "always host-resident here)", True)
+
+    def _fit(self, t: Table) -> "ClassBalancerModel":
+        values, counts = np.unique(t[self.input_col], return_counts=True)
+        weights = counts.max() / counts.astype(np.float64)
+        return ClassBalancerModel(
+            input_col=self.input_col, output_col=self.output_col,
+            broadcast_join=self.broadcast_join,
+            values=values, weights=weights)
+
+
+class ClassBalancerModel(Model, HasInputCol, HasOutputCol):
+    """Joins the label->weight map onto the input (reference:
+    stages/ClassBalancer.scala:66-101)."""
+    input_col = Param("input_col", "label column", "label")
+    output_col = Param("output_col", "weight column", "weight")
+    broadcast_join = Param("broadcast_join", "API parity flag", True)
+    values = Param("values", "distinct label values", None)
+    weights = Param("weights", "weight per distinct label value", None)
+
+    def _transform(self, t: Table) -> Table:
+        values, weights = self.values, self.weights
+        if values is None:
+            raise ValueError("ClassBalancerModel is not fitted")
+        col = t[self.input_col]
+        idx = np.searchsorted(values, col)
+        idx = np.clip(idx, 0, len(np.asarray(values)) - 1)
+        matched = np.asarray(values)[idx] == col
+        w = np.where(matched, np.asarray(weights)[idx], np.nan)
+        return t.with_column(self.output_col, w)

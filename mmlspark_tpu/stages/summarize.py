@@ -1,0 +1,122 @@
+"""SummarizeData: per-column summary statistics as a Table (reference:
+stages/SummarizeData.scala:20-238). Output schema matches the reference's
+field lists (SummarizeData.scala:197-237): a 'Feature' row per input column
+plus Count/Basic/Sample/Percentile blocks gated by the boolean params.
+Quantiles are exact (np.quantile) — error_threshold exists for API parity;
+0 means exact in the reference too (SummarizeData.scala:70-73).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Param, Table, Transformer
+from ..core.params import in_range
+
+COUNT_FIELDS = ["Count", "Unique_Value_Count", "Missing_Value_Count"]
+BASIC_FIELDS = ["Min", "1st_Quartile", "Median", "3rd_Quartile", "Max"]
+SAMPLE_FIELDS = ["Sample_Variance", "Sample_Standard_Deviation",
+                 "Sample_Skewness", "Sample_Kurtosis"]
+PERCENTILE_QUANTILES = [0.005, 0.01, 0.05, 0.95, 0.99, 0.995]
+PERCENTILE_FIELDS = ["P0_5", "P1", "P5", "P95", "P99", "P99_5"]
+
+
+def _is_numeric(col: np.ndarray) -> bool:
+    return col.ndim == 1 and np.issubdtype(col.dtype, np.number)
+
+
+def _missing_mask(col: np.ndarray) -> np.ndarray:
+    if np.issubdtype(col.dtype, np.floating):
+        return np.isnan(col)
+    if col.dtype == object:
+        return np.array([v is None or (isinstance(v, float) and np.isnan(v))
+                         for v in col])
+    return np.zeros(col.shape[0], dtype=bool)
+
+
+class SummarizeData(Transformer):
+    """Compute count/basic/sample/percentile statistics for every column.
+
+    Sample skewness/kurtosis use the population-moment definitions Spark's
+    `skewness`/`kurtosis` aggregates use (m3/m2^1.5 and m4/m2^2 - 3);
+    variance/std are the n-1 sample forms, matching `variance`/`stddev`
+    (SummarizeData.scala:152-160).
+    """
+    counts = Param("counts", "compute count statistics", True)
+    basic = Param("basic", "compute basic statistics", True)
+    sample = Param("sample", "compute sample statistics", True)
+    percentiles = Param("percentiles", "compute percentiles", True)
+    error_threshold = Param("error_threshold",
+                            "quantile error threshold - 0 is exact", 0.0,
+                            validator=in_range(0.0))
+
+    def _transform(self, t: Table) -> Table:
+        fields = ["Feature"]
+        if self.counts:
+            fields += COUNT_FIELDS
+        if self.basic:
+            fields += BASIC_FIELDS
+        if self.sample:
+            fields += SAMPLE_FIELDS
+        if self.percentiles:
+            fields += PERCENTILE_FIELDS
+
+        rows: dict[str, list] = {f: [] for f in fields}
+        for name in t.columns:
+            col = np.asarray(t[name])
+            rows["Feature"].append(name)
+            # vector columns (ndim > 1): like Spark's NumericType filter,
+            # numeric stats are NaN (computeOnNumeric), counts treat each
+            # row-vector as one value (computeOnAll)
+            numeric = _is_numeric(col)
+            missing = _missing_mask(col) if col.ndim == 1 else \
+                np.zeros(col.shape[0], dtype=bool)
+            valid = col[~missing] if missing.any() else col
+            if self.counts:
+                n_missing = float(missing.sum())
+                rows["Count"].append(float(col.shape[0]) - n_missing)
+                rows["Unique_Value_Count"].append(
+                    float(len(np.unique(valid.reshape(valid.shape[0], -1)
+                                        if valid.ndim > 1 else valid,
+                                        axis=0 if valid.ndim > 1 else None)))
+                    if col.shape[0] else 0.0)
+                rows["Missing_Value_Count"].append(n_missing)
+            stats = self._numeric_stats(valid.astype(np.float64)) \
+                if numeric and valid.shape[0] else {}
+            if self.basic:
+                for f in BASIC_FIELDS:
+                    rows[f].append(stats.get(f, np.nan))
+            if self.sample:
+                for f in SAMPLE_FIELDS:
+                    rows[f].append(stats.get(f, np.nan))
+            if self.percentiles:
+                for f in PERCENTILE_FIELDS:
+                    rows[f].append(stats.get(f, np.nan))
+
+        data = {"Feature": np.asarray(rows["Feature"], dtype=object)}
+        for f in fields[1:]:
+            data[f] = np.asarray(rows[f], dtype=np.float64)
+        return Table(data, t.npartitions)
+
+    def _numeric_stats(self, v: np.ndarray) -> dict:
+        out = {}
+        n = v.shape[0]
+        if self.basic:
+            q = np.quantile(v, [0.0, 0.25, 0.5, 0.75, 1.0])
+            out.update(zip(BASIC_FIELDS, q))
+        if self.sample:
+            mean = v.mean()
+            d = v - mean
+            m2 = float((d ** 2).mean())
+            var = float(v.var(ddof=1)) if n > 1 else np.nan
+            out["Sample_Variance"] = var
+            out["Sample_Standard_Deviation"] = float(np.sqrt(var)) if n > 1 else np.nan
+            if m2 > 0:
+                out["Sample_Skewness"] = float((d ** 3).mean() / m2 ** 1.5)
+                out["Sample_Kurtosis"] = float((d ** 4).mean() / m2 ** 2 - 3.0)
+            else:
+                out["Sample_Skewness"] = np.nan
+                out["Sample_Kurtosis"] = np.nan
+        if self.percentiles:
+            q = np.quantile(v, PERCENTILE_QUANTILES)
+            out.update(zip(PERCENTILE_FIELDS, q))
+        return out

@@ -1,0 +1,93 @@
+"""Timer: wrap any stage and log how long its fit/transform takes (reference:
+stages/Timer.scala:20-133). The timing hook doubles as the framework's
+light profiling stage — pair with utils.stopwatch for code-level timing and
+jax.profiler (utils.tracing) for device traces.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ..core import Estimator, Model, Param, Table, Transformer
+from ..core.pipeline import PipelineStage
+
+_logger = logging.getLogger("mmlspark_tpu.timer")
+
+
+class _TimerParams:
+    log_to_console = Param("log_to_console",
+                           "print timing lines (Timer.scala logToScala)", True)
+    disable_materialization = Param(
+        "disable_materialization",
+        "when False, force host materialization before/after so the timing "
+        "covers real work, not lazy views (Timer.scala:31-36)", True)
+
+
+def _emit(stage, seconds: float, action: str, count, enabled: bool):
+    amount = f" {count} rows" if count is not None else ""
+    msg = f"{type(stage).__name__} took {seconds}s to {action}{amount}"
+    _logger.info(msg)
+    if enabled:
+        print(msg)
+
+
+class Timer(Estimator, _TimerParams):
+    """Times the wrapped stage's fit (reference: Timer.scala:55-88); produces
+    a TimerModel that times every transform."""
+    stage = Param("stage", "inner stage to time", None)
+
+    def __init__(self, stage: Optional[PipelineStage] = None, **kw):
+        super().__init__(**kw)
+        if stage is not None:
+            self.set(stage=stage)
+
+    def fit_with_time(self, t: Table):
+        inner = self.stage
+        if inner is None:
+            raise ValueError("Timer: stage param is not set")
+        count = None if self.disable_materialization else len(t.materialize())
+        if isinstance(inner, Estimator):
+            t0 = time.perf_counter()
+            fitted = inner.fit(t)
+            elapsed = time.perf_counter() - t0
+            msg = f"{type(inner).__name__} fit in {elapsed}s"
+            _emit(inner, elapsed, "fit", count, False)
+        else:
+            fitted, msg = inner, ""
+        model = TimerModel(
+            transformer=fitted, log_to_console=self.log_to_console,
+            disable_materialization=self.disable_materialization)
+        return model, msg
+
+    def _fit(self, t: Table) -> "TimerModel":
+        model, msg = self.fit_with_time(t)
+        if msg and self.log_to_console:
+            print(msg)
+        return model
+
+
+class TimerModel(Model, _TimerParams):
+    """Times the wrapped transformer (reference: Timer.scala:90-133)."""
+    transformer = Param("transformer", "inner transformer to time", None)
+
+    def transform_with_time(self, t: Table):
+        inner = self.transformer
+        if inner is None:
+            raise ValueError("TimerModel: transformer param is not set")
+        before = t if self.disable_materialization else t.materialize()
+        count = None if self.disable_materialization else len(before)
+        t0 = time.perf_counter()
+        out = inner.transform(before)
+        if not self.disable_materialization:
+            out = out.materialize()
+        elapsed = time.perf_counter() - t0
+        return out, f"{type(inner).__name__} took {elapsed}s to transform" + (
+            f" {count} rows" if count is not None else "")
+
+    def _transform(self, t: Table) -> Table:
+        out, msg = self.transform_with_time(t)
+        _logger.info(msg)
+        if self.log_to_console:
+            print(msg)
+        return out

@@ -166,3 +166,21 @@ def test_virtual_device_mesh():
     assert n == 10
     assert x.shape[0] == 16
     assert float(jax.numpy.sum(x)) == 45.0
+
+
+def test_table_holds_device_arrays_lazily():
+    """Device columns stay on device between stages; materialize() is the
+    explicit host sync (what Cacher/Timer's barrier actually forces)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from mmlspark_tpu import Table
+
+    dev = jnp.arange(6.0)
+    t = Table({"x": dev, "y": np.arange(6.0)})
+    assert not isinstance(t["x"], np.ndarray)  # still a jax array
+    t2 = t.with_column("z", dev * 2)
+    assert not isinstance(t2["z"], np.ndarray)
+    m = t2.materialize()
+    for c in ("x", "y", "z"):
+        assert isinstance(m[c], np.ndarray), c
+    np.testing.assert_allclose(m["z"], np.arange(6.0) * 2)

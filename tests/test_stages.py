@@ -1,0 +1,317 @@
+"""Utility stage zoo tests (reference test models: stages/*Suite.scala via the
+fuzzing triad — see tests/fuzzing.py)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.stages import (Cacher, ClassBalancer, DropColumns,
+                                 EnsembleByKey, Explode, Lambda,
+                                 MultiColumnAdapter, RenameColumn, Repartition,
+                                 SelectColumns, StratifiedRepartition,
+                                 SummarizeData, TextPreprocessor, Timer,
+                                 UDFTransformer, UnicodeNormalize)
+from tests.fuzzing import fuzz_estimator, fuzz_transformer
+
+# fuzzed via variables below (the meta-test's static scan only sees direct
+# fuzz_*(ClassName calls), plus models constructed inside fuzzed estimators
+FUZZ_COVERED = ["ClassBalancerModel", "TimerModel", "MultiColumnAdapter",
+                "TextPreprocessor", "Timer"]
+
+
+@pytest.fixture
+def tab():
+    rng = np.random.default_rng(0)
+    return Table({
+        "a": rng.normal(size=20).astype(np.float64),
+        "b": rng.integers(0, 3, size=20).astype(np.int64),
+        "label": np.array([0, 1, 2, 0] * 5, dtype=np.int64),
+        "text": np.array(["The Happy Sad Dog", "Tale of Two Cities"] * 10,
+                         dtype=object),
+    }, npartitions=2)
+
+
+def test_drop_select_rename(tab):
+    out = fuzz_transformer(DropColumns(cols=["text"]), tab)
+    assert out.columns == ["a", "b", "label"]
+    out = fuzz_transformer(SelectColumns(cols=["b", "a"]), tab)
+    assert out.columns == ["b", "a"]
+    out = fuzz_transformer(RenameColumn(input_col="a", output_col="z"), tab)
+    assert "z" in out and "a" not in out
+    with pytest.raises(KeyError):
+        DropColumns(cols=["nope"]).transform(tab)
+    with pytest.raises(KeyError):
+        SelectColumns(cols=["nope"]).transform(tab)
+
+
+def test_repartition_cacher(tab):
+    out = fuzz_transformer(Repartition(n=4), tab)
+    assert out.npartitions == 4
+    assert Repartition(n=4, disable=True).transform(tab).npartitions == 2
+    out = fuzz_transformer(Cacher(), tab)
+    assert len(out) == len(tab)
+
+
+def test_explode(tab):
+    batched = Table({
+        "k": np.array([0, 1]),
+        "v": np.array([np.array([1.0, 2.0]), np.array([3.0])], dtype=object),
+    })
+    out = fuzz_transformer(Explode(input_col="v", output_col="e"), batched)
+    np.testing.assert_array_equal(out["k"], [0, 0, 1])
+    np.testing.assert_allclose(out["e"], [1.0, 2.0, 3.0])
+    # 2-D columns explode along axis 1
+    mat = Table({"k": np.array([0, 1]), "v": np.arange(6.).reshape(2, 3)})
+    out = Explode(input_col="v", output_col="e").transform(mat)
+    assert len(out) == 6
+
+
+def _double(col):
+    return col * 2.0
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_udf_transformer(tab):
+    out = fuzz_transformer(
+        UDFTransformer(input_col="a", output_col="a2", udf=_double), tab)
+    np.testing.assert_allclose(out["a2"], tab["a"] * 2.0)
+    out = fuzz_transformer(
+        UDFTransformer(input_cols=["a", "b"], output_col="s", udf=_add), tab)
+    np.testing.assert_allclose(out["s"], tab["a"] + tab["b"])
+    # scalar (non-vectorized) udf
+    out = UDFTransformer(input_col="b", output_col="neg", udf=_double,
+                         vectorized=False).transform(tab)
+    np.testing.assert_allclose(out["neg"], tab["b"] * 2.0)
+    with pytest.raises(ValueError):
+        UDFTransformer(input_col="a", output_col="x").transform(tab)
+
+
+def _lambda_fn(t):
+    return t.with_column("n", np.arange(len(t)))
+
+
+def test_lambda(tab):
+    out = fuzz_transformer(Lambda(transform_fn=_lambda_fn), tab)
+    np.testing.assert_array_equal(out["n"], np.arange(len(tab)))
+
+
+def test_callable_serialization_policy(tab, tmp_path, monkeypatch):
+    """Module-level fns save by qualified name; closures need the pickle
+    opt-in; pickled artifacts refuse to load without it."""
+    monkeypatch.delenv("MMLSPARK_TPU_PICKLE_UDFS", raising=False)
+    stage = UDFTransformer(input_col="a", output_col="o", udf=_double)
+    stage.save(str(tmp_path / "named"))
+    loaded = UDFTransformer.load(str(tmp_path / "named"))
+    assert loaded.udf is _double
+    # numpy ufuncs (no __module__) also resolve by name
+    UDFTransformer(input_col="a", output_col="o",
+                   udf=np.log1p).save(str(tmp_path / "ufunc"))
+    assert UDFTransformer.load(str(tmp_path / "ufunc")).udf is np.log1p
+
+    import functools
+    bound = UDFTransformer(input_col="a", output_col="o",
+                           udf=functools.partial(np.add, 3.0))
+    with pytest.raises(TypeError, match="MMLSPARK_TPU_PICKLE_UDFS"):
+        bound.save(str(tmp_path / "bound"))
+    monkeypatch.setenv("MMLSPARK_TPU_PICKLE_UDFS", "1")
+    bound.save(str(tmp_path / "bound"))
+    monkeypatch.delenv("MMLSPARK_TPU_PICKLE_UDFS")
+    with pytest.raises(ValueError, match="refusing to unpickle"):
+        UDFTransformer.load(str(tmp_path / "bound"))
+    # lambdas are rejected with the actionable message either way
+    with pytest.raises(TypeError, match="module-level"):
+        UDFTransformer(input_col="a", output_col="o",
+                       udf=lambda c: c + 1).save(str(tmp_path / "lam"))
+
+
+def test_stratified_repartition_modes(tab):
+    for mode in ("original", "equal", "mixed"):
+        out = fuzz_transformer(
+            StratifiedRepartition(label_col="label", mode=mode, seed=1), tab)
+        # every partition must contain every label (the stage's contract,
+        # StratifiedRepartition.scala:27-29)
+        for part in out.partitions():
+            assert set(np.unique(part["label"])) == {0, 1, 2}, mode
+    # original mode keeps counts
+    out = StratifiedRepartition(label_col="label", mode="original").transform(tab)
+    assert len(out) == len(tab)
+    # equal mode balances counts
+    skew = Table({"label": np.array([0] * 12 + [1] * 2), "x": np.arange(14.0)},
+                 npartitions=2)
+    out = StratifiedRepartition(label_col="label", mode="equal").transform(skew)
+    _, counts = np.unique(out["label"], return_counts=True)
+    assert counts[0] == counts[1] == 12
+
+
+def test_stratified_repartition_imbalanced():
+    # original mode must still spread the minority label across partitions
+    skew = Table({"label": np.array([0] * 12 + [1] * 2), "x": np.arange(14.0)},
+                 npartitions=2)
+    out = StratifiedRepartition(label_col="label", mode="original").transform(skew)
+    for part in out.partitions():
+        assert set(np.unique(part["label"])) == {0, 1}
+    # mixed mode only lifts under-represented labels: majority count unchanged
+    big = Table({"label": np.array([0] * 100 + [1]), "x": np.arange(101.0)},
+                npartitions=2)
+    out = StratifiedRepartition(label_col="label", mode="mixed").transform(big)
+    _, counts = np.unique(out["label"], return_counts=True)
+    assert counts[0] == 100  # majority NOT upsampled
+    assert counts[1] == 51  # minority lifted to ceil(101/2)
+    for part in out.partitions():
+        assert set(np.unique(part["label"])) == {0, 1}
+
+
+def test_summarize_data(tab):
+    out = fuzz_transformer(SummarizeData(), tab)
+    feats = list(out["Feature"])
+    assert feats == ["a", "b", "label", "text"]
+    i = feats.index("a")
+    a = tab["a"]
+    np.testing.assert_allclose(out["Count"][i], 20.0)
+    np.testing.assert_allclose(out["Min"][i], a.min())
+    np.testing.assert_allclose(out["Max"][i], a.max())
+    np.testing.assert_allclose(out["Median"][i], np.median(a))
+    np.testing.assert_allclose(out["Sample_Variance"][i], a.var(ddof=1))
+    d = a - a.mean()
+    np.testing.assert_allclose(out["Sample_Skewness"][i],
+                               (d**3).mean() / (d**2).mean()**1.5)
+    # non-numeric columns get NaN numeric stats but real counts
+    j = feats.index("text")
+    assert np.isnan(out["Min"][j])
+    np.testing.assert_allclose(out["Unique_Value_Count"][j], 2.0)
+    # flags prune blocks
+    out = SummarizeData(percentiles=False, sample=False).transform(tab)
+    assert "P99" not in out.columns and "Sample_Kurtosis" not in out.columns
+
+
+def test_summarize_missing_values():
+    t = Table({"x": np.array([1.0, np.nan, 3.0, np.nan])})
+    out = SummarizeData().transform(t)
+    np.testing.assert_allclose(out["Missing_Value_Count"][0], 2.0)
+    np.testing.assert_allclose(out["Count"][0], 2.0)
+    np.testing.assert_allclose(out["Min"][0], 1.0)
+
+
+def test_ensemble_by_key(tab):
+    t = Table({
+        "k": np.array([0, 0, 1, 1, 1]),
+        "score": np.array([1.0, 3.0, 2.0, 4.0, 6.0]),
+        "vec": np.arange(10.0).reshape(5, 2),
+    })
+    out = fuzz_transformer(EnsembleByKey(keys=["k"], cols=["score"]), t)
+    np.testing.assert_allclose(sorted(out["mean(score)"]), [2.0, 4.0])
+    # vector column + join-back mode
+    out = EnsembleByKey(keys=["k"], cols=["vec"], col_names=["mv"],
+                        collapse_group=False).transform(t)
+    assert out["mv"].shape == (5, 2)
+    np.testing.assert_allclose(out["mv"][0], out["mv"][1])
+    # compound keys
+    t2 = Table({"k1": np.array([0, 0, 1]), "k2": np.array(["x", "x", "y"]),
+                "s": np.array([1.0, 2.0, 3.0])})
+    out = EnsembleByKey(keys=["k1", "k2"], cols=["s"]).transform(t2)
+    assert len(out) == 2
+    # distinct tuples whose concatenation collides must stay separate groups
+    t3 = Table({"k1": np.array(["ab", "a"], dtype=object),
+                "k2": np.array(["c", "bc"], dtype=object),
+                "s": np.array([1.0, 2.0])})
+    out = EnsembleByKey(keys=["k1", "k2"], cols=["s"]).transform(t3)
+    assert len(out) == 2
+
+
+def test_class_balancer(tab):
+    model, out = fuzz_estimator(ClassBalancer(input_col="label"), tab)
+    # label 0 appears 10x, labels 1/2 appear 5x -> weights 1, 2, 2
+    np.testing.assert_allclose(out["weight"],
+                               np.where(tab["label"] == 0, 1.0, 2.0))
+    skew = Table({"label": np.array([0] * 9 + [1] * 3)})
+    m = ClassBalancer(input_col="label").fit(skew)
+    out = m.transform(skew)
+    np.testing.assert_allclose(out["weight"][:9], 1.0)
+    np.testing.assert_allclose(out["weight"][9:], 3.0)
+
+
+def test_multi_column_adapter(tab):
+    from mmlspark_tpu.featurize.value_indexer import ValueIndexer
+    adapter = MultiColumnAdapter(
+        base_stage=ValueIndexer(), input_cols=["b", "label"],
+        output_cols=["b_ix", "label_ix"])
+    model, out = fuzz_estimator(adapter, tab)
+    assert "b_ix" in out and "label_ix" in out
+    with pytest.raises(ValueError):
+        MultiColumnAdapter(base_stage=ValueIndexer(), input_cols=["a"],
+                           output_cols=[]).fit(tab)
+
+
+def test_timer(tab, capsys):
+    t = Timer(stage=ClassBalancer(input_col="label"))
+    model, out = fuzz_estimator(t, tab)
+    assert "weight" in out
+    capsys.readouterr()
+    model.transform(tab)
+    assert "took" in capsys.readouterr().out
+    # transformer stages pass through without fitting
+    m2 = Timer(stage=DropColumns(cols=["text"]),
+               log_to_console=False).fit(tab)
+    assert "text" not in m2.transform(tab).columns
+
+
+def test_text_preprocessor(tab):
+    tp = TextPreprocessor(
+        map={"happy": "sad", "Sad": "sad"}, norm_func="lower",
+        input_col="text", output_col="norm")
+    out = fuzz_transformer(tp, tab)
+    assert out["norm"][0] == "the sad sad dog"
+    # longest-match wins and mid-word matches are rejected on BOTH sides
+    tp2 = TextPreprocessor(map={"cat": "dog", "category": "group"},
+                           input_col="text", output_col="o")
+    t = Table({"text": np.array(["category cat concatenate tomcat"],
+                                dtype=object)})
+    assert tp2.transform(t)["o"][0] == "group dog concatenate tomcat"
+
+
+def test_unicode_normalize():
+    t = Table({"text": np.array(["ＨＥＬＬＯ Ⅳ", None], dtype=object)})
+    out = fuzz_transformer(
+        UnicodeNormalize(input_col="text", output_col="n", form="NFKC"), t)
+    assert out["n"][0] == "hello iv"
+    assert out["n"][1] is None
+    out = UnicodeNormalize(input_col="text", output_col="n", form="NFKC",
+                           lower=False).transform(t)
+    assert out["n"][0] == "HELLO IV"
+
+
+def test_named_fn_traversal_rejected(tmp_path):
+    """A tampered artifact must not resolve callables by walking through
+    module attributes or into denylisted modules."""
+    import json
+    from mmlspark_tpu.core.serialize import _resolve_named_fn
+    with pytest.raises(ValueError, match="refusing"):
+        _resolve_named_fn({"kind": "named_fn", "module": "zipfile",
+                           "qualname": "shutil.rmtree"})
+    with pytest.raises(ValueError, match="refusing"):
+        _resolve_named_fn({"kind": "named_fn", "module": "os",
+                           "qualname": "system"})
+
+
+def test_summarize_vector_columns():
+    t = Table({"emb": np.arange(12.0).reshape(4, 3),
+               "x": np.arange(4.0)})
+    out = SummarizeData().transform(t)
+    i = list(out["Feature"]).index("emb")
+    assert np.isnan(out["Min"][i])  # numeric stats only for 1-D columns
+    np.testing.assert_allclose(out["Count"][i], 4.0)
+    np.testing.assert_allclose(out["Unique_Value_Count"][i], 4.0)
+
+
+def _jax_scale(col):
+    import jax.numpy as jnp
+    return jnp.asarray(col) * 2.0
+
+
+def test_udf_device_passthrough(tab):
+    out = UDFTransformer(input_col="a", output_col="d",
+                         udf=_jax_scale).transform(tab)
+    assert not isinstance(out["d"], np.ndarray)  # stayed a device array
+    np.testing.assert_allclose(np.asarray(out["d"]), tab["a"] * 2.0, rtol=1e-6)
