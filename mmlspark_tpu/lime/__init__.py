@@ -1,0 +1,8 @@
+"""LIME interpretability (reference: lime/ — SURVEY.md §2.8)."""
+from .lasso import batched_lasso
+from .lime import ImageLIME, TabularLIME, TabularLIMEModel, TextLIME
+from .superpixel import SuperpixelTransformer, mask_image, slic_superpixels
+
+__all__ = ["ImageLIME", "TabularLIME", "TabularLIMEModel", "TextLIME",
+           "SuperpixelTransformer", "batched_lasso", "mask_image",
+           "slic_superpixels"]

@@ -1,0 +1,72 @@
+"""Batched lasso/least-squares solver for LIME local models.
+
+Role-equivalent to the reference's LassoUtils.lasso + fitLasso UDF
+(lime/LassoUtils.scala, org/apache/spark/ml/LimeNamespaceInjections.scala:11-14),
+re-designed TPU-first: instead of one breeze solve per row inside a UDF, ALL
+rows' local linear models are solved in one vmapped device call — LIME's
+per-row (n_samples x d) problems are tiny, identical-shape, and perfectly
+batchable, which is exactly the shape the MXU wants.
+
+lambda == 0 falls back to ridge with a tiny jitter (least squares); lambda > 0
+runs fixed-iteration coordinate descent (ISTA-style proximal updates are
+jit-friendly: no data-dependent control flow).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _solve_batch(x, y, lam, n_iters):
+    import jax
+    import jax.numpy as jnp
+
+    def solve_one(xi, yi):
+        xm = xi.mean(axis=0, keepdims=True)
+        ym = yi.mean()
+        xc = xi - xm
+        yc = yi - ym
+        n = xi.shape[0]
+        gram = xc.T @ xc / n                      # (D, D)
+        corr = xc.T @ yc / n                      # (D,)
+        if lam == 0.0:
+            d = gram.shape[0]
+            w = jnp.linalg.solve(gram + 1e-6 * jnp.eye(d, dtype=gram.dtype),
+                                 corr)
+            return w
+        # proximal gradient (ISTA) with Lipschitz step; fixed iterations keep
+        # the loop compile-friendly (no convergence branch)
+        lip = jnp.maximum(jnp.trace(gram), 1e-6)
+
+        def step(w, _):
+            grad = gram @ w - corr
+            w2 = w - grad / lip
+            w2 = jnp.sign(w2) * jnp.maximum(jnp.abs(w2) - lam / lip, 0.0)
+            return w2, None
+
+        w0 = jnp.zeros(gram.shape[0], gram.dtype)
+        w, _ = jax.lax.scan(step, w0, None, length=n_iters)
+        return w
+
+    return jax.vmap(solve_one)(x, y)
+
+
+_solve_batch_jit = None  # module-level jit: cached across LIME transforms
+
+
+def batched_lasso(x: np.ndarray, y: np.ndarray, lam: float,
+                  n_iters: int = 200) -> np.ndarray:
+    """Solve argmin_w 0.5/n ||y - x @ w - b||^2 + lam * |w|_1 for a batch.
+
+    x: (B, S, D) design matrices, y: (B, S) targets. Returns (B, D) coefs.
+    Intercepts are fit implicitly by centering (standard lasso practice) and
+    not returned — parity with fitLasso, which returns only coefficients.
+    """
+    import jax
+    import jax.numpy as jnp
+    global _solve_batch_jit
+    if _solve_batch_jit is None:
+        _solve_batch_jit = jax.jit(_solve_batch,
+                                   static_argnames=("lam", "n_iters"))
+    return np.asarray(_solve_batch_jit(jnp.asarray(x, jnp.float32),
+                                       jnp.asarray(y, jnp.float32),
+                                       float(lam), n_iters))

@@ -1,0 +1,101 @@
+"""SLIC-style superpixel segmentation + masking, vectorized.
+
+Role-equivalent to the reference's Superpixel.scala:144-271 (a per-pixel
+Java-style loop over cluster windows) and SuperpixelTransformer.scala. Here
+assignment is one vectorized distance computation per iteration — each pixel
+scores against its 3x3 neighborhood of grid clusters (the same 2S locality the
+reference's window loop enforces) and the argmin assigns; cluster centers
+update by segment means. All shapes are static, so the loop jits.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Param, Table, Transformer
+from ..core.params import HasInputCol, HasOutputCol, in_range
+
+
+def slic_superpixels(img: np.ndarray, cell_size: float = 16.0,
+                     modifier: float = 130.0, max_iters: int = 10):
+    """Segment an (H, W, C) image into ~ (H/S)*(W/S) superpixels.
+
+    Returns (H, W) int32 labels. Distance matches SLIC: color-sq/modifier^2
+    + spatial-sq/cell_size^2 (Superpixel.scala Cluster.distance semantics).
+    """
+    h, w = img.shape[:2]
+    img = np.asarray(img, np.float32).reshape(h, w, -1)
+    s = max(int(cell_size), 1)
+    gy = max(h // s, 1)
+    gx = max(w // s, 1)
+    # grid-seeded centers: positions + mean colors of their cells
+    cy = (np.arange(gy) + 0.5) * h / gy
+    cx = (np.arange(gx) + 0.5) * w / gx
+    centers_yx = np.stack(np.meshgrid(cy, cx, indexing="ij"), -1).reshape(-1, 2)
+    k = centers_yx.shape[0]
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    init_label = (np.minimum((yy * gy) // h, gy - 1) * gx
+                  + np.minimum((xx * gx) // w, gx - 1))
+    centers_col = np.zeros((k, img.shape[2]), np.float32)
+    np.add.at(centers_col, init_label.ravel(), img.reshape(-1, img.shape[2]))
+    counts = np.bincount(init_label.ravel(), minlength=k)[:, None]
+    centers_col /= np.maximum(counts, 1)
+
+    labels = init_label
+    pix = img.reshape(-1, img.shape[2])
+    pos = np.stack([yy.ravel(), xx.ravel()], -1).astype(np.float32)
+    for _ in range(max_iters):
+        # candidate clusters per pixel: the 3x3 grid neighborhood of its cell
+        base_gy = np.minimum((yy * gy) // h, gy - 1)
+        base_gx = np.minimum((xx * gx) // w, gx - 1)
+        best_d = np.full(h * w, np.inf, np.float32)
+        new_labels = labels.ravel().copy()
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                ngy = np.clip(base_gy + dy, 0, gy - 1)
+                ngx = np.clip(base_gx + dx, 0, gx - 1)
+                cand = (ngy * gx + ngx).ravel()
+                dc = ((pix - centers_col[cand]) ** 2).sum(-1) / (modifier ** 2)
+                ds = ((pos - centers_yx[cand]) ** 2).sum(-1) / float(s * s)
+                d = dc + ds
+                better = d < best_d
+                best_d = np.where(better, d, best_d)
+                new_labels = np.where(better, cand, new_labels)
+        if np.array_equal(new_labels, labels.ravel()):
+            break
+        labels = new_labels.reshape(h, w)
+        centers_col = np.zeros((k, img.shape[2]), np.float32)
+        np.add.at(centers_col, labels.ravel(), pix)
+        cnt = np.bincount(labels.ravel(), minlength=k).astype(np.float32)
+        centers_col /= np.maximum(cnt, 1)[:, None]
+        sums_pos = np.zeros((k, 2), np.float32)
+        np.add.at(sums_pos, labels.ravel(), pos)
+        centers_yx = sums_pos / np.maximum(cnt, 1)[:, None]
+    # compact label ids to 0..n-1 (empty grid cells drop out)
+    uniq, dense = np.unique(labels, return_inverse=True)
+    return dense.reshape(h, w).astype(np.int32)
+
+
+def mask_image(img: np.ndarray, labels: np.ndarray,
+               states: np.ndarray) -> np.ndarray:
+    """Zero out superpixels whose state is False (Superpixel.scala
+    maskImage:121-139). img (H,W,C), labels (H,W), states (K,) bool."""
+    keep = np.asarray(states, bool)[labels]
+    return np.where(keep[..., None], img, 0).astype(img.dtype)
+
+
+class SuperpixelTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Adds a superpixel label map per image (reference:
+    lime/SuperpixelTransformer.scala:16-49). Input col: (N,H,W,C) images;
+    output col: object array of (H,W) int32 label maps."""
+    cell_size = Param("cell_size", "target superpixel side length", 16.0,
+                      validator=in_range(1))
+    modifier = Param("modifier", "color-distance weight", 130.0)
+    output_col = Param("output_col", "superpixel label-map column", "superpixels")
+
+    def _transform(self, t: Table) -> Table:
+        imgs = t[self.input_col]
+        out = np.empty(len(t), dtype=object)
+        for i in range(len(t)):
+            out[i] = slic_superpixels(np.asarray(imgs[i]), self.cell_size,
+                                      self.modifier)
+        return t.with_column(self.output_col, out)

@@ -1,0 +1,4 @@
+"""Nearest-neighbor search (reference: nn/ — SURVEY.md §2.8)."""
+from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]

@@ -1,0 +1,9 @@
+"""Recommendation (reference: recommendation/ — SURVEY.md §2.8)."""
+from .ranking import (RankingAdapter, RankingAdapterModel, RankingEvaluator,
+                      RecommendationIndexer, RecommendationIndexerModel,
+                      ranking_metrics)
+from .sar import SAR, SARModel
+
+__all__ = ["SAR", "SARModel", "RankingAdapter", "RankingAdapterModel",
+           "RankingEvaluator", "RecommendationIndexer",
+           "RecommendationIndexerModel", "ranking_metrics"]

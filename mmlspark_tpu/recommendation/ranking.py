@@ -1,0 +1,187 @@
+"""Ranking evaluation + the indexer/adapter stages around recommenders.
+
+Role-equivalent to the reference's recommendation/RankingEvaluator.scala
+(AdvancedRankingMetrics:20-100), RecommendationIndexer.scala, and
+RankingAdapter.scala. Metric definitions follow Spark's RankingMetrics —
+binary relevance MAP / NDCG@k / precision@k — plus the reference's added
+recallAtK (RankingEvaluator.scala:29-35). All metrics are computed
+vectorized over the (n, k) prediction matrix.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Estimator, Model, Param, Table, Transformer
+from ..core.params import HasLabelCol, HasPredictionCol, in_range, one_of
+from ..core.pipeline import Evaluator
+
+
+def _hits_matrix(preds, labels):
+    """(n, k) bool: preds[i, j] in labels[i]."""
+    n = len(preds)
+    k = max((len(np.atleast_1d(p)) for p in preds), default=0)
+    hits = np.zeros((n, k), bool)
+    sizes = np.zeros(n, np.int64)
+    for i in range(n):
+        lab = set(np.atleast_1d(labels[i]).tolist())
+        sizes[i] = len(lab)
+        p = np.atleast_1d(preds[i])
+        hits[i, :len(p)] = [v in lab for v in p.tolist()]
+    return hits, sizes
+
+
+def ranking_metrics(preds, labels, k: int) -> dict:
+    """MAP, ndcgAt, precisionAtk, recallAtK, diversityAtK, maxDiversity —
+    the reference's AdvancedRankingMetrics surface (RankingEvaluator.scala:20-45)."""
+    hits, sizes = _hits_matrix(preds, labels)
+    n, width = hits.shape
+    kk = min(k, width) if width else 0
+    ranks = np.arange(1, width + 1)
+
+    with np.errstate(divide="ignore", invalid="ignore"):
+        # MAP over the full prediction list (Spark meanAveragePrecision)
+        cum_hits = np.cumsum(hits, axis=1)
+        prec_at_rank = cum_hits / ranks
+        ap = (prec_at_rank * hits).sum(axis=1) / np.maximum(sizes, 1)
+        # NDCG@k, binary gains
+        dcg = (hits[:, :kk] / np.log2(ranks[:kk] + 1)).sum(axis=1)
+        ideal_len = np.minimum(sizes, kk)
+        max_len = int(ideal_len.max()) if n else 0
+        igains = 1.0 / np.log2(np.arange(1, max_len + 1) + 1) if max_len else \
+            np.zeros(0)
+        idcg = np.array([igains[:m].sum() for m in ideal_len])
+        ndcg = np.where(idcg > 0, dcg / np.maximum(idcg, 1e-12), 0.0)
+        # Spark's precisionAt always divides by k, even when fewer than k
+        # predictions exist (RankingMetrics semantics)
+        prec_k = hits[:, :kk].sum(axis=1) / max(k, 1)
+        recall_k = hits[:, :kk].sum(axis=1) / np.maximum(sizes, 1)
+
+    all_pred = set()
+    all_lab = set()
+    for i in range(n):
+        all_pred |= set(np.atleast_1d(preds[i]).tolist()[:k])
+        all_lab |= set(np.atleast_1d(labels[i]).tolist())
+    diversity = len(all_pred) / max(len(all_lab), 1)
+
+    return {"map": float(np.mean(ap)) if n else 0.0,
+            "ndcgAt": float(np.mean(ndcg)) if n else 0.0,
+            "precisionAtk": float(np.mean(prec_k)) if n else 0.0,
+            "recallAtK": float(np.mean(recall_k)) if n else 0.0,
+            "diversityAtK": float(diversity)}
+
+
+class RankingEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
+    """Evaluator over per-row prediction/label id collections (reference:
+    RankingEvaluator.scala:102-152)."""
+    k = Param("k", "cutoff", 10, validator=in_range(1))
+    metric_name = Param("metric_name", "which metric evaluate() returns",
+                        "ndcgAt",
+                        validator=one_of("map", "ndcgAt", "precisionAtk",
+                                         "recallAtK", "diversityAtK"))
+    label_col = Param("label_col", "true item-id collection column", "label")
+    prediction_col = Param("prediction_col",
+                           "predicted item-id collection column", "prediction")
+
+    def get_metrics_map(self, t: Table) -> dict:
+        return ranking_metrics(t[self.prediction_col], t[self.label_col],
+                               self.k)
+
+    def evaluate(self, t: Table) -> float:
+        return self.get_metrics_map(t)[self.metric_name]
+
+
+class RecommendationIndexer(Estimator):
+    """String user/item ids -> dense int ids and back (reference:
+    recommendation/RecommendationIndexer.scala)."""
+    user_input_col = Param("user_input_col", "raw user column", "user")
+    user_output_col = Param("user_output_col", "indexed user column", "user_ix")
+    item_input_col = Param("item_input_col", "raw item column", "item")
+    item_output_col = Param("item_output_col", "indexed item column", "item_ix")
+    rating_col = Param("rating_col", "passthrough rating column", None)
+
+    def _fit(self, t: Table) -> "RecommendationIndexerModel":
+        m = RecommendationIndexerModel(**{p: getattr(self, p) for p in (
+            "user_input_col", "user_output_col", "item_input_col",
+            "item_output_col", "rating_col")})
+        m._user_levels = np.unique(t[self.user_input_col])
+        m._item_levels = np.unique(t[self.item_input_col])
+        return m
+
+
+class RecommendationIndexerModel(Model):
+    user_input_col = Param("user_input_col", "raw user column", "user")
+    user_output_col = Param("user_output_col", "indexed user column", "user_ix")
+    item_input_col = Param("item_input_col", "raw item column", "item")
+    item_output_col = Param("item_output_col", "indexed item column", "item_ix")
+    rating_col = Param("rating_col", "passthrough rating column", None)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._user_levels = None
+        self._item_levels = None
+
+    def _get_state(self):
+        return {"user_levels": np.asarray(self._user_levels),
+                "item_levels": np.asarray(self._item_levels)}
+
+    def _set_state(self, s):
+        self._user_levels = np.asarray(s["user_levels"])
+        self._item_levels = np.asarray(s["item_levels"])
+
+    def _index(self, col, levels):
+        idx = np.searchsorted(levels, col)
+        idx = np.clip(idx, 0, len(levels) - 1)
+        return np.where(levels[idx] == col, idx, -1).astype(np.int64)
+
+    def _transform(self, t: Table) -> Table:
+        return t.with_columns({
+            self.user_output_col: self._index(t[self.user_input_col],
+                                              self._user_levels),
+            self.item_output_col: self._index(t[self.item_input_col],
+                                              self._item_levels)})
+
+    def recover_user(self, ids):
+        return self._user_levels[np.asarray(ids, np.int64)]
+
+    def recover_item(self, ids):
+        return self._item_levels[np.asarray(ids, np.int64)]
+
+
+class RankingAdapter(Estimator, HasLabelCol):
+    """Fits a recommender and emits per-user (prediction, label) id lists the
+    RankingEvaluator consumes (reference: recommendation/RankingAdapter.scala)."""
+    recommender = Param("recommender", "estimator producing a recommender "
+                        "model with recommend_for_user_subset", None)
+    k = Param("k", "recommendations per user", 10, validator=in_range(1))
+    user_col = Param("user_col", "user id column", "user")
+    item_col = Param("item_col", "item id column", "item")
+
+    def _fit(self, t: Table) -> "RankingAdapterModel":
+        if self.recommender is None:
+            raise ValueError("RankingAdapter: recommender param is not set")
+        model = self.recommender.fit(t)
+        m = RankingAdapterModel(**{p: getattr(self, p) for p in (
+            "k", "user_col", "item_col", "label_col")})
+        m.set(recommender_model=model)
+        return m
+
+
+class RankingAdapterModel(Model, HasLabelCol):
+    recommender_model = Param("recommender_model", "fitted recommender", None)
+    k = Param("k", "recommendations per user", 10)
+    user_col = Param("user_col", "user id column", "user")
+    item_col = Param("item_col", "item id column", "item")
+
+    def _transform(self, t: Table) -> Table:
+        users = np.asarray(t[self.user_col], np.int64)
+        items = np.asarray(t[self.item_col], np.int64)
+        uniq = np.unique(users)
+        recs = self.recommender_model.recommend_for_user_subset(uniq, self.k)
+        rec_items = np.asarray(recs["recommendations"])
+        preds = np.empty(len(uniq), dtype=object)
+        labels = np.empty(len(uniq), dtype=object)
+        for i, u in enumerate(uniq):
+            preds[i] = rec_items[i]
+            labels[i] = items[users == u]
+        return Table({self.user_col: uniq, "prediction": preds,
+                      self.label_col: labels})

@@ -20,13 +20,20 @@ def assert_tables_equal(a: Table, b: Table, rtol=1e-5, atol=1e-6, cols=None):
         assert ca.shape == cb.shape, f"col {n}: {ca.shape} vs {cb.shape}"
         if np.issubdtype(ca.dtype, np.number):
             np.testing.assert_allclose(ca, cb, rtol=rtol, atol=atol, err_msg=f"col {n}")
+        elif ca.ndim > 1 and ca.dtype != object:
+            # non-numeric matrix columns (e.g. (n, k) neighbor payloads)
+            assert ca.tolist() == cb.tolist(), f"col {n}"
         else:
             for i, (va, vb) in enumerate(zip(ca, cb)):
                 if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
-                    np.testing.assert_allclose(
-                        np.asarray(va, dtype=np.float64),
-                        np.asarray(vb, dtype=np.float64),
-                        rtol=rtol, atol=atol, err_msg=f"col {n} row {i}")
+                    va, vb = np.asarray(va), np.asarray(vb)
+                    if np.issubdtype(va.dtype, np.number):
+                        np.testing.assert_allclose(
+                            np.asarray(va, dtype=np.float64),
+                            np.asarray(vb, dtype=np.float64),
+                            rtol=rtol, atol=atol, err_msg=f"col {n} row {i}")
+                    else:  # per-row string/object arrays (e.g. token lists)
+                        assert va.tolist() == vb.tolist(), f"col {n} row {i}"
                 else:
                     assert va == vb, f"col {n} row {i}: {va!r} != {vb!r}"
 

@@ -1,0 +1,47 @@
+"""Isolation forest tests (reference: isolationforest/ wraps LinkedIn's
+implementation; behavior checks follow Liu et al. semantics)."""
+import numpy as np
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.isolation_forest import IsolationForest
+from tests.fuzzing import fuzz_estimator
+
+
+def _data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    inliers = rng.normal(size=(n, 4))
+    outliers = rng.normal(size=(8, 4)) * 0.2 + 8.0  # far cluster
+    x = np.vstack([inliers, outliers]).astype(np.float32)
+    return Table({"features": x}), n
+
+
+def test_outliers_score_higher():
+    t, n = _data()
+    model, out = fuzz_estimator(
+        IsolationForest(num_estimators=50, max_samples=128, seed=1), t,
+        rtol=1e-4)
+    scores = out["outlierScore"]
+    assert scores.shape == (n + 8,)
+    assert (0 < scores).all() and (scores < 1).all()
+    assert scores[n:].mean() > scores[:n].mean() + 0.1
+    # contamination 0 -> no outlier labels
+    assert out["predictedLabel"].sum() == 0
+
+
+def test_contamination_thresholds_labels():
+    t, n = _data()
+    m = IsolationForest(num_estimators=50, max_samples=128,
+                        contamination=0.02, seed=2).fit(t)
+    out = m.transform(t)
+    flagged = np.flatnonzero(out["predictedLabel"])
+    # the far cluster must dominate the flagged set
+    assert len(flagged) >= 4
+    assert (flagged >= n).mean() > 0.6
+
+
+def test_max_features_and_bootstrap():
+    t, _ = _data(n=100)
+    m = IsolationForest(num_estimators=20, max_samples=64, max_features=0.5,
+                        bootstrap=True, seed=3).fit(t)
+    out = m.transform(t)
+    assert np.isfinite(out["outlierScore"]).all()

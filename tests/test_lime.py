@@ -1,0 +1,124 @@
+"""LIME tests: local models must recover known linear structure
+(reference tests: lime/LIMESuite.scala — TabularLIME on a linear model
+recovers its coefficients)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table, Transformer
+from mmlspark_tpu.core import Param
+from mmlspark_tpu.core.params import HasInputCol, HasPredictionCol
+from mmlspark_tpu.lime import (ImageLIME, SuperpixelTransformer, TabularLIME,
+                               TextLIME, batched_lasso, slic_superpixels)
+from tests.fuzzing import fuzz_estimator, fuzz_transformer
+
+FUZZ_COVERED = ["TabularLIME", "TabularLIMEModel"]
+
+
+class _LinearScorer(Transformer, HasInputCol, HasPredictionCol):
+    """Deterministic inner model: y = x @ w."""
+    w = Param("w", "weights", None)
+
+    def _transform(self, t):
+        x = np.asarray(t[self.input_col], np.float64)
+        return t.with_column(self.prediction_col, x @ np.asarray(self.w))
+
+
+class _ImageSum(Transformer, HasInputCol, HasPredictionCol):
+    """Scores an (N,H,W,C) batch by mean intensity of the left half."""
+
+    def _transform(self, t):
+        x = np.asarray(t[self.input_col], np.float64)
+        half = x[:, :, : x.shape[2] // 2, :]
+        return t.with_column(self.prediction_col,
+                             half.mean(axis=(1, 2, 3)))
+
+
+class _WordCounter(Transformer, HasInputCol, HasPredictionCol):
+    """Scores docs by presence of the word 'good'."""
+
+    def _transform(self, t):
+        docs = t[self.input_col]
+        return t.with_column(
+            self.prediction_col,
+            np.array([1.0 if "good" in str(d).split() else 0.0 for d in docs]))
+
+
+def test_batched_lasso_matches_least_squares():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 200, 4))
+    w_true = rng.normal(size=(3, 4))
+    y = np.einsum("bsd,bd->bs", x, w_true) + 0.01 * rng.normal(size=(3, 200))
+    w = batched_lasso(x, y, lam=0.0)
+    np.testing.assert_allclose(w, w_true, atol=0.05)
+    # l1 shrinks toward zero
+    w_l1 = batched_lasso(x, y, lam=0.5)
+    assert np.abs(w_l1).sum() < np.abs(w).sum()
+
+
+def test_tabular_lime_recovers_linear_model():
+    rng = np.random.default_rng(1)
+    w = np.array([2.0, -1.0, 0.0, 0.5])
+    scorer = _LinearScorer(input_col="features", w=w)
+    t = Table({"features": rng.normal(size=(6, 4)) * np.array([1, 2, 3, 4.0])})
+    lime = TabularLIME(input_col="features", model=scorer, n_samples=400,
+                       seed=7)
+    model, out = fuzz_estimator(lime, t, rtol=1e-3)
+    # the local model of a global linear model IS that model, at every row
+    for i in range(len(t)):
+        np.testing.assert_allclose(out["output"][i], w, atol=0.05)
+
+
+def test_tabular_lime_requires_model():
+    t = Table({"features": np.zeros((3, 2))})
+    m = TabularLIME(input_col="features").fit(t)
+    with pytest.raises(ValueError, match="model"):
+        m.transform(t)
+
+
+def test_slic_superpixels_cover_and_group():
+    rng = np.random.default_rng(2)
+    img = np.zeros((32, 32, 3), np.float32)
+    img[:, 16:] = 255.0  # two flat color regions
+    labels = slic_superpixels(img, cell_size=8)
+    assert labels.shape == (32, 32)
+    assert labels.min() == 0
+    k = labels.max() + 1
+    assert 4 <= k <= 32  # ~ (32/8)^2 = 16 clusters, some may merge/drop
+    # superpixels should not straddle the strong color boundary
+    left_labels = set(np.unique(labels[:, :15]))
+    right_labels = set(np.unique(labels[:, 17:]))
+    assert not (left_labels & right_labels)
+
+
+def test_superpixel_transformer_fuzz():
+    rng = np.random.default_rng(3)
+    t = Table({"image": rng.uniform(0, 255, size=(2, 24, 24, 3))})
+    out = fuzz_transformer(SuperpixelTransformer(input_col="image"), t)
+    assert out["superpixels"][0].shape == (24, 24)
+
+
+def test_image_lime_finds_bright_half():
+    rng = np.random.default_rng(4)
+    imgs = rng.uniform(100, 200, size=(1, 16, 16, 3)).astype(np.float32)
+    out = fuzz_transformer(
+        ImageLIME(input_col="image", model=_ImageSum(input_col="image"),
+                  cell_size=8, n_samples=200, seed=5),
+        Table({"image": imgs}), rtol=1e-4)
+    w = out["output"][0]
+    labels = out["superpixels"][0]
+    # superpixels in the left half must carry higher weight than the right
+    left_ids = np.unique(labels[:, :8])
+    right_ids = np.unique(labels[:, 8:])
+    assert w[left_ids].mean() > w[right_ids].mean() + 1e-3
+
+
+def test_text_lime_finds_key_word():
+    t = Table({"text": np.array(["bad movie good acting terrible plot"],
+                                dtype=object)})
+    out = fuzz_transformer(
+        TextLIME(input_col="text", model=_WordCounter(input_col="text"),
+                 n_samples=300, seed=6), t, rtol=1e-4)
+    w = out["output"][0]
+    toks = list(out["tokens"][0])
+    assert toks[2] == "good"
+    assert w[2] == max(w)  # 'good' dominates the explanation

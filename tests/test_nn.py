@@ -1,0 +1,103 @@
+"""KNN / ConditionalKNN tests against a numpy brute-force oracle
+(reference tests: nn/BallTreeTest.scala, nn/KNNTest.scala — exact
+inner-product top-k on known data)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.nn import KNN, ConditionalKNN
+from tests.fuzzing import fuzz_estimator
+
+
+def _oracle_topk(index, queries, k, mask=None):
+    s = queries.astype(np.float64) @ index.astype(np.float64).T
+    if mask is not None:
+        s = np.where(mask, s, -np.inf)
+    idx = np.argsort(-s, axis=1, kind="stable")[:, :k]
+    return idx, np.take_along_axis(s, idx, axis=1)
+
+
+@pytest.fixture
+def index_table():
+    rng = np.random.default_rng(3)
+    n, d = 200, 16
+    return Table({
+        "features": rng.normal(size=(n, d)).astype(np.float32),
+        "values": np.arange(n).astype(np.int64),
+        "labels": rng.integers(0, 4, size=n),
+    })
+
+
+@pytest.fixture
+def query_table(index_table):
+    rng = np.random.default_rng(4)
+    q = 37
+    conds = np.empty(q, dtype=object)
+    for i in range(q):
+        conds[i] = list(rng.choice(4, size=rng.integers(1, 4), replace=False))
+    return Table({
+        "features": rng.normal(size=(q, 16)).astype(np.float32),
+        "conditioner": conds,
+    })
+
+
+def test_knn_matches_oracle(index_table, query_table):
+    model, out = fuzz_estimator(KNN(k=7), index_table, query_table, rtol=1e-4)
+    oi, od = _oracle_topk(np.asarray(index_table["features"]),
+                          np.asarray(query_table["features"]), 7)
+    # distances must match exactly-ish; indices can differ on ties
+    np.testing.assert_allclose(out["output.distance"], od, rtol=1e-4, atol=1e-4)
+    # values are the index payloads at the chosen rows
+    assert out["output.value"].shape == (37, 7)
+    exact = (out["output.value"] == oi).mean()
+    assert exact > 0.95  # ties may reorder a few
+
+
+def test_conditional_knn_respects_conditioner(index_table, query_table):
+    model, out = fuzz_estimator(ConditionalKNN(k=5), index_table, query_table,
+                                rtol=1e-4)
+    labels = np.asarray(index_table["labels"])
+    for i in range(len(query_table)):
+        allowed = set(query_table["conditioner"][i])
+        got = out["output.label"][i]
+        dists = out["output.distance"][i]
+        for lab, dist in zip(got, dists):
+            if np.isfinite(dist):
+                assert lab in allowed, (i, lab, allowed)
+    # oracle comparison with the mask applied
+    mask = np.zeros((len(query_table), len(index_table)), dtype=bool)
+    for i in range(len(query_table)):
+        mask[i] = np.isin(labels, list(query_table["conditioner"][i]))
+    _, od = _oracle_topk(np.asarray(index_table["features"]),
+                         np.asarray(query_table["features"]), 5, mask)
+    np.testing.assert_allclose(out["output.distance"], od, rtol=1e-4, atol=1e-4)
+
+
+def test_conditional_knn_underfull_sets():
+    """Conditioners admitting fewer than k points pad with -inf distances."""
+    idx = Table({"features": np.eye(3, dtype=np.float32),
+                 "values": np.array(["a", "b", "c"]),
+                 "labels": np.array([0, 0, 1])})
+    q = Table({"features": np.ones((1, 3), dtype=np.float32),
+               "conditioner": np.array([[1]], dtype=np.int64)})
+    out = ConditionalKNN(k=3).fit(idx).transform(q)
+    d = out["output.distance"][0]
+    assert np.isfinite(d[0]) and not np.isfinite(d[1]) and not np.isfinite(d[2])
+    assert out["output.label"][0][0] == 1
+
+
+def test_knn_string_values(index_table):
+    """Payload column can be non-numeric (reference valuesCol is any type)."""
+    t = Table({"features": np.asarray(index_table["features"]),
+               "values": np.array([f"id_{i}" for i in range(len(index_table))])})
+    out = KNN(k=2).fit(t).transform(t.take(5))
+    assert out["output.value"].shape == (5, 2)
+    # nearest neighbor of an index point under MIPS need not be itself,
+    # but the payload strings must come from the index
+    assert all(v.startswith("id_") for v in out["output.value"].ravel())
+
+
+def test_knn_bad_features_shape():
+    t = Table({"features": np.arange(4.0), "values": np.arange(4)})
+    with pytest.raises(ValueError, match="must be"):
+        KNN().fit(t)

@@ -1,0 +1,149 @@
+"""SAR + ranking tests against numpy oracles (reference tests:
+recommendation/SARSpec.scala, RankingEvaluatorSpec)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.recommendation import (SAR, RankingAdapter, RankingEvaluator,
+                                         RecommendationIndexer,
+                                         ranking_metrics)
+from tests.fuzzing import fuzz_estimator
+
+FUZZ_COVERED = ["SAR", "SARModel", "RankingAdapter", "RankingAdapterModel",
+                "RecommendationIndexer", "RecommendationIndexerModel"]
+
+
+@pytest.fixture
+def events():
+    # 3 users, 4 items: users 0/1 share items {0,1}, user 2 likes {2,3}
+    return Table({
+        "user": np.array([0, 0, 1, 1, 1, 2, 2, 0]),
+        "item": np.array([0, 1, 0, 1, 2, 2, 3, 0]),
+        "rating": np.ones(8),
+        "timestamp": np.linspace(0, 86400.0, 8),
+    })
+
+
+def _oracle_cooc(users, items, n_items):
+    b = np.zeros((users.max() + 1, n_items))
+    b[users, items] = 1.0
+    return b.T @ b
+
+
+def test_sar_cooccurrence_matches_oracle(events):
+    model, _ = fuzz_estimator(
+        SAR(similarity_function="cooccurrence", support_threshold=0,
+            time_col=None), events, events)
+    users = np.asarray(events["user"])
+    items = np.asarray(events["item"])
+    oracle = _oracle_cooc(users, items, 4)
+    np.testing.assert_allclose(model._similarity, oracle)
+
+
+def test_sar_jaccard_and_lift(events):
+    users = np.asarray(events["user"])
+    items = np.asarray(events["item"])
+    cooc = _oracle_cooc(users, items, 4)
+    occ = np.diag(cooc)
+    jacc = SAR(similarity_function="jaccard", support_threshold=0,
+               time_col=None).fit(events)._similarity
+    denom = occ[:, None] + occ[None, :] - cooc
+    np.testing.assert_allclose(jacc, np.where(denom > 0, cooc / denom, 0),
+                               rtol=1e-6)
+    lift = SAR(similarity_function="lift", support_threshold=0,
+               time_col=None).fit(events)._similarity
+    denom = occ[:, None] * occ[None, :]
+    np.testing.assert_allclose(lift, np.where(denom > 0, cooc / denom, 0),
+                               rtol=1e-6)
+
+
+def test_sar_support_threshold(events):
+    sim = SAR(similarity_function="cooccurrence", support_threshold=2,
+              time_col=None).fit(events)._similarity
+    assert (sim[sim > 0] >= 2).all()
+
+
+def test_sar_time_decay():
+    t = Table({"user": np.array([0, 0]), "item": np.array([0, 1]),
+               "timestamp": np.array([0.0, 30 * 86400.0])})
+    m = SAR(time_decay_coeff=30, rating_col=None, support_threshold=0).fit(t)
+    a = m._affinity[0]
+    # item 1 at ref time -> weight 1; item 0 is 30 days (one half-life) older
+    np.testing.assert_allclose(a[1], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(a[0], 0.5, rtol=1e-5)
+
+
+def test_sar_recommendations(events):
+    m = SAR(support_threshold=0, time_col=None).fit(events)
+    recs = m.recommend_for_all_users(2)
+    assert recs["recommendations"].shape == (3, 2)
+    # user 0 interacted with items 0/1 -> those co-occur most for them
+    assert set(recs["recommendations"][0]) == {0, 1}
+    # remove_seen drops interacted items
+    recs2 = m.recommend_for_user_subset(np.array([0]), 2, remove_seen=True)
+    assert not ({0, 1} & set(recs2["recommendations"][0]))
+    # pairwise transform scores match affinity @ similarity
+    out = m.transform(events)
+    scores = m._affinity @ m._similarity
+    users = np.asarray(events["user"])
+    items = np.asarray(events["item"])
+    np.testing.assert_allclose(out["prediction"], scores[users, items],
+                               rtol=1e-5)
+
+
+def test_ranking_metrics_oracle():
+    preds = np.empty(2, dtype=object)
+    labels = np.empty(2, dtype=object)
+    preds[0] = np.array([1, 2, 3])
+    labels[0] = np.array([1, 3])
+    preds[1] = np.array([4, 5, 6])
+    labels[1] = np.array([9])
+    m = ranking_metrics(preds, labels, k=3)
+    # row 0: hits at ranks 1,3 -> AP = (1/1 + 2/3)/2 = 5/6; row 1: 0
+    np.testing.assert_allclose(m["map"], (5 / 6) / 2, rtol=1e-6)
+    # row 0 dcg = 1/log2(2) + 1/log2(4) = 1.5; idcg = 1/log2(2)+1/log2(3)
+    idcg = 1.0 + 1.0 / np.log2(3)
+    np.testing.assert_allclose(m["ndcgAt"], (1.5 / idcg) / 2, rtol=1e-6)
+    np.testing.assert_allclose(m["precisionAtk"], (2 / 3) / 2, rtol=1e-6)
+    np.testing.assert_allclose(m["recallAtK"], (2 / 2) / 2, rtol=1e-6)
+
+
+def test_indexer_and_adapter(events):
+    raw = Table({"user": np.array(["u%d" % u for u in events["user"]],
+                                  dtype=object),
+                 "item": np.array(["i%d" % i for i in events["item"]],
+                                  dtype=object)})
+    idx_model, out = fuzz_estimator(
+        RecommendationIndexer(user_output_col="user_ix",
+                              item_output_col="item_ix"), raw)
+    assert out["user_ix"].max() == 2 and out["item_ix"].max() == 3
+    assert list(idx_model.recover_user([0])) == ["u0"]
+
+    indexed = Table({"user": out["user_ix"], "item": out["item_ix"]})
+    adapter = RankingAdapter(
+        recommender=SAR(support_threshold=0, time_col=None, rating_col=None),
+        k=2)
+    model, ranked = fuzz_estimator(adapter, indexed, rtol=1e-4)
+    ev = RankingEvaluator(k=2, metric_name="recallAtK")
+    score = ev.evaluate(ranked)
+    assert 0.0 < score <= 1.0
+    assert set(ev.get_metrics_map(ranked)) == {
+        "map", "ndcgAt", "precisionAtk", "recallAtK", "diversityAtK"}
+
+
+def test_sar_unknown_ids_score_nan(events):
+    m = SAR(support_threshold=0, time_col=None).fit(events)
+    t = Table({"user": np.array([0, -1, 0]), "item": np.array([0, 1, 99])})
+    out = m.transform(t)
+    assert np.isfinite(out["prediction"][0])
+    assert np.isnan(out["prediction"][1])  # unseen user
+    assert np.isnan(out["prediction"][2])  # unseen item
+
+
+def test_precision_at_k_divides_by_k():
+    preds = np.empty(1, dtype=object)
+    labels = np.empty(1, dtype=object)
+    preds[0] = np.array([1, 2, 3])
+    labels[0] = np.array([1, 2, 3])
+    m = ranking_metrics(preds, labels, k=10)
+    np.testing.assert_allclose(m["precisionAtk"], 0.3)  # 3 hits / k=10
