@@ -31,6 +31,8 @@ class Booster(NamedTuple):
     objective: str
     n_features: int
     best_iteration: int = -1    # early stopping; -1 = use all trees
+    gain: Optional[np.ndarray] = None    # (T, max_nodes) f32 split gains
+    cover: Optional[np.ndarray] = None   # (T, max_nodes) f32 node row counts
 
     @property
     def n_trees(self) -> int:
@@ -60,18 +62,38 @@ class Booster(NamedTuple):
             self.split_feature[s], self.threshold[s], self.max_depth))
 
     def feature_contributions(self, x):
-        """Per-feature additive contributions (SHAP-style path attribution,
-        reference: featuresShap, LightGBMBooster.scala). Computed by the
-        interventional 'Saabas' path method per tree, vectorized in numpy."""
+        """Per-feature additive contributions via exact path-dependent
+        TreeSHAP (Lundberg et al. 2018, Algorithm 2) — the same attribution
+        LightGBM's predict(pred_contrib=True) / the reference's featuresShap
+        column computes (lightgbm/booster/LightGBMBooster.scala featuresShap).
+
+        Returns (n, n_features + 1); the last column is the expected value
+        (bias). For multiclass boosters, contributions of all classes' trees
+        are summed per feature (use tree_class to split if needed).
+        Requires node covers (recorded during training); boosters loaded from
+        pre-cover artifacts fall back to the Saabas approximation.
+        """
         x = np.asarray(x, dtype=np.float32)
         n = x.shape[0]
         contrib = np.zeros((n, self.n_features + 1), dtype=np.float64)
         s = self._used_trees()
         sf, thr, lv = self.split_feature[s], self.threshold[s], self.leaf_value[s]
+        if self.cover is None:
+            return self._saabas_contributions(x, sf, thr, lv)
+        cover = self.cover[s]
+        for t in range(sf.shape[0]):
+            phi = _tree_shap(sf[t], thr[t], lv[t], cover[t], x,
+                             self.n_features)
+            contrib += phi
+        return contrib
+
+    def _saabas_contributions(self, x, sf, thr, lv):
+        """Legacy fallback: uniform-weight path attribution."""
+        n = x.shape[0]
+        contrib = np.zeros((n, self.n_features + 1), dtype=np.float64)
         for t in range(sf.shape[0]):
             node = np.zeros(n, dtype=np.int64)
-            # expected value per node (bottom-up)
-            ev, cover = _node_expectations(sf[t], lv[t], self.max_depth)
+            ev = _node_expectations(sf[t], lv[t])
             contrib[:, -1] += ev[0]
             for _ in range(self.max_depth):
                 f = sf[t][node]
@@ -80,29 +102,35 @@ class Booster(NamedTuple):
                 child = np.where(xf <= thr[t][node], 2 * node + 1, 2 * node + 2)
                 nxt = np.where(leaf, node, child)
                 delta = ev[nxt] - ev[node]
-                valid = ~leaf
-                np.add.at(contrib, (np.arange(n), np.clip(f, 0, self.n_features - 1)),
-                          np.where(valid, delta, 0.0))
+                np.add.at(contrib,
+                          (np.arange(n), np.clip(f, 0, self.n_features - 1)),
+                          np.where(~leaf, delta, 0.0))
                 node = nxt
         return contrib
 
     # -- introspection ------------------------------------------------------
     def feature_importances(self, importance_type: str = "split"):
+        """'split' = split counts; 'gain' = summed split gains — exact
+        LightGBM semantics (featureImportances, LightGBMBooster.scala)."""
         s = self._used_trees()
         sf = self.split_feature[s]
-        out = np.zeros(self.n_features, dtype=np.float64)
-        if importance_type == "split":
-            for f in range(self.n_features):
-                out[f] = np.sum(sf == f)
-        else:  # gain-proxy: sum of |leaf values| routed below splits of f
-            lv = np.abs(self.leaf_value[s]).sum()
-            for f in range(self.n_features):
-                out[f] = np.sum(sf == f) * lv / max((sf >= 0).sum(), 1)
-        return out
+        if importance_type != "split" and self.gain is None:
+            import warnings
+            warnings.warn(
+                "booster has no recorded split gains (pre-upgrade artifact "
+                "or mixed merge); falling back to split counts",
+                stacklevel=2)
+        split_ids = sf[sf >= 0].ravel()
+        if importance_type == "split" or self.gain is None:
+            weights = None
+        else:
+            weights = self.gain[s][sf >= 0].ravel().astype(np.float64)
+        return np.bincount(split_ids, weights=weights,
+                           minlength=self.n_features).astype(np.float64)
 
     # -- persistence ---------------------------------------------------------
     def to_dict(self) -> dict:
-        return {
+        out = {
             "meta": json.dumps({
                 "max_depth": self.max_depth, "n_classes": self.n_classes,
                 "objective": self.objective, "n_features": self.n_features,
@@ -113,6 +141,11 @@ class Booster(NamedTuple):
             "leaf_value": self.leaf_value,
             "tree_class": self.tree_class,
         }
+        if self.gain is not None:
+            out["gain"] = self.gain
+        if self.cover is not None:
+            out["cover"] = self.cover
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "Booster":
@@ -122,6 +155,8 @@ class Booster(NamedTuple):
                    split_bin=np.asarray(d["split_bin"]),
                    leaf_value=np.asarray(d["leaf_value"]),
                    tree_class=np.asarray(d["tree_class"]),
+                   gain=(np.asarray(d["gain"]) if "gain" in d else None),
+                   cover=(np.asarray(d["cover"]) if "cover" in d else None),
                    **meta)
 
     def save_model_string(self) -> str:
@@ -147,6 +182,8 @@ class Booster(NamedTuple):
             best = self.n_trees // per_iter + other.best_iteration
         else:
             best = -1
+        both_aux = self.gain is not None and other.gain is not None \
+            and self.cover is not None and other.cover is not None
         return Booster(
             split_feature=np.concatenate([a[0], b[0]]),
             threshold=np.concatenate([a[1], b[1]]),
@@ -154,31 +191,159 @@ class Booster(NamedTuple):
             leaf_value=np.concatenate([a[3], b[3]]),
             tree_class=np.concatenate([self.tree_class, other.tree_class]),
             max_depth=md, n_classes=self.n_classes, objective=self.objective,
-            n_features=self.n_features, best_iteration=best)
+            n_features=self.n_features, best_iteration=best,
+            gain=np.concatenate([a[4], b[4]]) if both_aux else None,
+            cover=np.concatenate([a[5], b[5]]) if both_aux else None)
 
 
 def _pad_depth(b: Booster, max_depth: int):
     target = 2 ** (max_depth + 1) - 1
     cur = b.split_feature.shape[1]
+    shape = (b.split_feature.shape[0], cur)
+    gain = b.gain if b.gain is not None else np.zeros(shape, np.float32)
+    cover = b.cover if b.cover is not None else np.zeros(shape, np.float32)
     if cur == target:
-        return (b.split_feature, b.threshold, b.split_bin, b.leaf_value)
+        return (b.split_feature, b.threshold, b.split_bin, b.leaf_value,
+                gain, cover)
     pad = target - cur
 
     def p(a, fill):
         return np.pad(a, ((0, 0), (0, pad)), constant_values=fill)
     return (p(b.split_feature, -1), p(b.threshold, 0.0),
-            p(b.split_bin, 0), p(b.leaf_value, 0.0))
+            p(b.split_bin, 0), p(b.leaf_value, 0.0),
+            p(gain, 0.0), p(cover, 0.0))
 
 
-def _node_expectations(sf, lv, max_depth):
-    """Cover-weighted expected value per heap node, approximated with uniform
-    child weights (exact covers aren't stored; adequate for contributions)."""
+def _node_expectations(sf, lv):
+    """Uniform-child-weight expected value per heap node (Saabas fallback)."""
     m = sf.shape[0]
     ev = np.array(lv, dtype=np.float64)
-    cover = np.ones(m)
-    # bottom-up: internal node ev = mean of children
     for i in range(m - 1, -1, -1):
         l, r = 2 * i + 1, 2 * i + 2
         if sf[i] >= 0 and r < m:
             ev[i] = 0.5 * (ev[l] + ev[r])
-    return ev, cover
+    return ev
+
+
+def _tree_shap(sf, thr, lv, cover, x, n_features):
+    """Exact path-dependent TreeSHAP for one heap tree, vectorized over rows.
+
+    Transcription of TreeSHAP (Lundberg, Erion & Lee 2018, 'Consistent
+    Individualized Feature Attribution for Tree Ensembles', Algorithm 2 —
+    the algorithm behind LightGBM/XGBoost pred_contrib and the shap
+    package's tree_path_dependent mode). The tree's node sequence is
+    identical for every sample — only the 'hot' (followed) child differs —
+    so path state carries per-sample vectors: one_fraction and pweight are
+    (n,)-wide per path slot while zero_fraction/feature are scalars. One
+    DFS over <= 2^(d+1) nodes explains all rows at once.
+    """
+    n = x.shape[0]
+    max_len = int(np.log2(sf.shape[0] + 1)) + 2
+    phi = np.zeros((n, n_features + 1), dtype=np.float64)
+
+    def extend(feats, zeros, ones, pweights, plen, pz, po, pi):
+        """EXTEND: append (pi, pz, po) and update subset weights."""
+        feats[plen] = pi
+        zeros[plen] = pz
+        ones[:, plen] = po
+        pweights[:, plen] = 1.0 if plen == 0 else 0.0
+        for i in range(plen - 1, -1, -1):
+            pweights[:, i + 1] += po * pweights[:, i] * (i + 1) / (plen + 1)
+            pweights[:, i] *= pz * (plen - i) / (plen + 1)
+
+    def unwound_sum(zeros, ones, pweights, plen, idx):
+        """UNWOUND_PATH_SUM: total pweight with path element idx removed."""
+        one_f = ones[:, idx]                      # (n,)
+        zero_f = float(zeros[idx])                # scalar
+        nonzero = one_f != 0
+        safe_one = np.where(nonzero, one_f, 1.0)
+        nxt = pweights[:, plen].copy()
+        total = np.zeros(n)
+        for i in range(plen - 1, -1, -1):
+            tmp_a = nxt * (plen + 1) / ((i + 1) * safe_one)
+            nxt_a = pweights[:, i] - tmp_a * zero_f * (plen - i) / (plen + 1)
+            if zero_f != 0:
+                tmp_b = (pweights[:, i] / zero_f) / ((plen - i) / (plen + 1))
+            else:
+                tmp_b = np.zeros(n)
+            total += np.where(nonzero, tmp_a, tmp_b)
+            nxt = np.where(nonzero, nxt_a, nxt)
+        return total
+
+    def unwind(feats, zeros, ones, pweights, plen, idx):
+        """UNWIND: remove path element idx in place; caller shortens plen."""
+        one_f = ones[:, idx].copy()
+        zero_f = float(zeros[idx])
+        nonzero = one_f != 0
+        safe_one = np.where(nonzero, one_f, 1.0)
+        nxt = pweights[:, plen].copy()
+        for i in range(plen - 1, -1, -1):
+            old = pweights[:, i].copy()
+            new_a = nxt * (plen + 1) / ((i + 1) * safe_one)
+            if zero_f != 0:
+                new_b = (old / zero_f) / ((plen - i) / (plen + 1))
+            else:
+                new_b = np.zeros(n)
+            pweights[:, i] = np.where(nonzero, new_a, new_b)
+            nxt = np.where(nonzero,
+                           old - new_a * zero_f * (plen - i) / (plen + 1),
+                           nxt)
+        for i in range(idx, plen):
+            feats[i] = feats[i + 1]
+            zeros[i] = zeros[i + 1]
+            ones[:, i] = ones[:, i + 1]
+
+    def recurse(node, plen, feats, zeros, ones, pweights, pz, po, pi):
+        feats = feats.copy()
+        zeros = zeros.copy()
+        ones = ones.copy()
+        pweights = pweights.copy()
+        extend(feats, zeros, ones, pweights, plen, pz, po, pi)
+        f = int(sf[node])
+        if f < 0 or 2 * node + 2 >= sf.shape[0]:  # leaf
+            for i in range(1, plen + 1):
+                w = unwound_sum(zeros, ones, pweights, plen, i)
+                phi[:, feats[i]] += w * (ones[:, i] - zeros[i]) * float(lv[node])
+            return
+        left, right = 2 * node + 1, 2 * node + 2
+        hot_is_left = x[:, f] <= thr[node]
+        c_node = max(float(cover[node]), 1e-12)
+        rz_left = float(cover[left]) / c_node
+        rz_right = float(cover[right]) / c_node
+        # a feature revisited along the path: its prior element is unwound
+        # and its fractions multiply into this split's (Algorithm 2 line 17)
+        iz, io = 1.0, np.ones(n)
+        sub_plen = plen
+        dup = next((i for i in range(1, plen + 1) if feats[i] == f), -1)
+        if dup >= 0:
+            iz = float(zeros[dup])
+            io = ones[:, dup].copy()
+            unwind(feats, zeros, ones, pweights, sub_plen, dup)
+            sub_plen -= 1
+        recurse(left, sub_plen + 1, feats, zeros, ones, pweights,
+                iz * rz_left, np.where(hot_is_left, io, 0.0), f)
+        recurse(right, sub_plen + 1, feats, zeros, ones, pweights,
+                iz * rz_right, np.where(hot_is_left, 0.0, io), f)
+
+    # expected value (bias): cover-weighted mean over terminal nodes
+    phi[:, -1] += _cover_weighted_expectation(sf, lv, cover)
+    feats0 = np.full(max_len, -1, dtype=np.int64)
+    zeros0 = np.ones(max_len)
+    ones0 = np.ones((n, max_len))
+    pweights0 = np.zeros((n, max_len))
+    recurse(0, 0, feats0, zeros0, ones0, pweights0, 1.0, np.ones(n), -1)
+    return phi
+
+
+def _cover_weighted_expectation(sf, lv, cover):
+    """E[f(x)] over the training distribution: cover-weighted leaf mean."""
+    m = sf.shape[0]
+    is_internal = np.zeros(m, bool)
+    for i in range(m):
+        if sf[i] >= 0 and 2 * i + 2 < m:
+            is_internal[i] = True
+    leaf_mask = ~is_internal & (cover > 0)
+    total = cover[leaf_mask].sum()
+    if total <= 0:
+        return 0.0
+    return float((lv[leaf_mask] * cover[leaf_mask]).sum() / total)

@@ -241,7 +241,7 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
         count_w = _presence(pres_j, row_w)
         fmask = _feature_mask(p, k_feat, cfg.n_features)
 
-        sfs, sbs, lvs = [], [], []
+        sfs, sbs, lvs, gns, cvs = [], [], [], [], []
         for k in range(k_out):
             gk = grad[:, k] if multiclass else grad
             hk = hess[:, k] if multiclass else hess
@@ -252,6 +252,8 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
             sfs.append(tree.split_feature)
             sbs.append(tree.split_bin)
             lvs.append(tree.leaf_value)
+            gns.append(tree.gain)
+            cvs.append(tree.cover)
             if multiclass:
                 margin = margin.at[:, k].add(delta)
             else:
@@ -269,23 +271,26 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
                                        p.num_class)
         else:
             metric = jnp.float32(0.0)
-        out = (jnp.stack(sfs), jnp.stack(sbs), jnp.stack(lvs), metric)
+        out = (jnp.stack(sfs), jnp.stack(sbs), jnp.stack(lvs),
+               jnp.stack(gns), jnp.stack(cvs), metric)
         return (margin, v_margin), out
 
     its = it_base + jnp.arange(chunk_len)
     keys = jax.random.split(key, chunk_len)
-    (margin, v_margin), (sf, sb, lv, metrics) = jax.lax.scan(
+    (margin, v_margin), (sf, sb, lv, gn, cv, metrics) = jax.lax.scan(
         one_iter, (margin, v_margin), (its, keys))
     # (chunk, K, max_nodes) -> (chunk*K, max_nodes), class-major per iteration
     sf = sf.reshape(-1, sf.shape[-1])
     sb = sb.reshape(-1, sb.shape[-1])
     lv = lv.reshape(-1, lv.shape[-1])
-    return margin, v_margin, sf, sb, lv, metrics
+    gn = gn.reshape(-1, gn.shape[-1])
+    cv = cv.reshape(-1, cv.shape[-1])
+    return margin, v_margin, sf, sb, lv, gn, cv, metrics
 
 
 def _build_booster(sf, sb, lv, tree_classes, mapper, p: BoostParams,
                    k_out: int, n_features: int, best_iter: int,
-                   init_booster, base):
+                   init_booster, base, gain=None, cover=None):
     """Stacked tree arrays -> Booster with real-valued thresholds."""
     thr = mapper.upper_bounds[np.clip(sf, 0, n_features - 1),
                               np.clip(sb, 0, p.max_bin - 1)]
@@ -296,7 +301,9 @@ def _build_booster(sf, sb, lv, tree_classes, mapper, p: BoostParams,
                       tree_class=np.asarray(tree_classes, np.int32),
                       max_depth=p.max_depth, n_classes=k_out,
                       objective=p.objective, n_features=n_features,
-                      best_iteration=best_iter)
+                      best_iteration=best_iter,
+                      gain=None if gain is None else gain.astype(np.float32),
+                      cover=None if cover is None else cover.astype(np.float32))
     if init_booster is not None:
         booster = init_booster.merge(booster)
     return booster
@@ -425,10 +432,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         while it < p.num_iterations:
             clen = min(chunk, p.num_iterations - it)
             key, kc = jax.random.split(key)
-            margin, v_margin_, sf_c, sb_c, lv_c, mts = fused(
+            margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, mts = fused(
                 d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_, vy_j,
                 v_margin_, kc, it, p, cfg, clen, k_out, has_valid=has_valid)
-            parts.append((sf_c, sb_c, lv_c))
+            parts.append((sf_c, sb_c, lv_c, gn_c, cv_c))
             if track:
                 for i, mv in enumerate(np.asarray(mts)):
                     mv = float(mv)
@@ -446,16 +453,19 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             it += clen
             if stop_at is not None:
                 break
-        sf = np.concatenate([np.asarray(s) for s, _, _ in parts])
-        sb = np.concatenate([np.asarray(s) for _, s, _ in parts])
-        lv = np.concatenate([np.asarray(s) for _, _, s in parts])
+        sf, sb, lv, gn, cv = (np.concatenate([np.asarray(part[i])
+                                              for part in parts])
+                              for i in range(5))
         if stop_at is not None:  # drop trees grown past the stopping point
-            sf, sb, lv = sf[:stop_at * k_out], sb[:stop_at * k_out], lv[:stop_at * k_out]
+            keep = stop_at * k_out
+            sf, sb, lv = sf[:keep], sb[:keep], lv[:keep]
+            gn, cv = gn[:keep], cv[:keep]
         tree_classes = np.tile(np.arange(k_out, dtype=np.int32),
                                sf.shape[0] // max(k_out, 1))
         booster = _build_booster(
             sf, sb, lv, tree_classes, mapper, p, k_out, n_features,
-            best_iter if (track and patience > 0) else -1, init_booster, base)
+            best_iter if (track and patience > 0) else -1, init_booster, base,
+            gain=gn, cover=cv)
         return booster, base, eval_history
 
     trees, tree_classes, train_deltas = [], [], []
@@ -601,20 +611,12 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     sf = np.stack([t.split_feature for t in trees]) if T else np.zeros((0, max_nodes), np.int32)
     sb = np.stack([t.split_bin for t in trees]) if T else np.zeros((0, max_nodes), np.int32)
     lv = np.stack([t.leaf_value for t in trees]) if T else np.zeros((0, max_nodes), np.float32)
+    gn = np.stack([t.gain for t in trees]) if T else np.zeros((0, max_nodes), np.float32)
+    cv = np.stack([t.cover for t in trees]) if T else np.zeros((0, max_nodes), np.float32)
     if dart and T:
         per_iter_w = np.repeat(np.asarray(dart_weights, np.float32), k_out)
         lv = lv * per_iter_w[:, None]
-    # real-valued thresholds from bin upper bounds (serve without the mapper)
-    thr = mapper.upper_bounds[np.clip(sf, 0, n_features - 1),
-                              np.clip(sb, 0, p.max_bin - 1)]
-    thr = np.where(sf >= 0, thr, 0.0).astype(np.float32)
-
-    booster = Booster(split_feature=sf.astype(np.int32), threshold=thr,
-                      split_bin=sb.astype(np.int32), leaf_value=lv.astype(np.float32),
-                      tree_class=np.asarray(tree_classes, np.int32),
-                      max_depth=p.max_depth, n_classes=k_out,
-                      objective=p.objective, n_features=n_features,
-                      best_iteration=best_iter if p.early_stopping_round > 0 else -1)
-    if init_booster is not None:
-        booster = init_booster.merge(booster)
-    return booster, base, eval_history
+    return _build_booster(
+        sf, sb, lv, np.asarray(tree_classes, np.int32), mapper, p, k_out,
+        n_features, best_iter if p.early_stopping_round > 0 else -1,
+        init_booster, base, gain=gn, cover=cv), base, eval_history

@@ -206,7 +206,13 @@ class _GBDTModelBase(Model, HasFeaturesCol, HasPredictionCol):
             t = t.with_column(lcol, self._booster.predict_leaf(x))
         scol = self.get("features_shap_col") if self.has_param("features_shap_col") else None
         if scol:
-            t = t.with_column(scol, self._booster.feature_contributions(x))
+            contrib = self._booster.feature_contributions(x)
+            # the init score (boost_from_average base) is part of the model's
+            # expected value: it belongs in the bias column so that
+            # sum(contrib) == full prediction (LightGBM pred_contrib does
+            # the same)
+            contrib[:, -1] += self._init_score
+            t = t.with_column(scol, contrib)
         return t
 
 

@@ -56,6 +56,8 @@ class Tree(NamedTuple):
     split_feature: jnp.ndarray  # i32; -1 where the node is a leaf
     split_bin: jnp.ndarray      # i32 bin threshold: go left if bin <= split_bin
     leaf_value: jnp.ndarray     # f32 output where rows rest
+    gain: jnp.ndarray           # f32 split gain at internal nodes (0 at leaves)
+    cover: jnp.ndarray          # f32 row count through each node (for SHAP)
 
 
 def _soft_threshold(g, l1):
@@ -165,6 +167,8 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     node_of_row = jnp.zeros(n, dtype=jnp.int32)
     split_feature = jnp.full(cfg.max_nodes, -1, dtype=jnp.int32)
     split_bin = jnp.zeros(cfg.max_nodes, dtype=jnp.int32)
+    gain_arr = jnp.zeros(cfg.max_nodes, dtype=jnp.float32)
+    cover_arr = jnp.zeros(cfg.max_nodes, dtype=jnp.float32)
     leaf_count = jnp.ones((), dtype=jnp.int32)
     # feature-major bins for row routing: one (n,)-stripe dynamic-slice per
     # split node beats any (n, F) materialization; shared with pallas_hist's
@@ -246,6 +250,13 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         split_feature = split_feature.at[heap_ids].set(
             jnp.where(apply, feat, -1))
         split_bin = split_bin.at[heap_ids].set(jnp.where(apply, thr, 0))
+        # bookkeeping for SHAP/importance: gains of applied splits, and the
+        # row count (cover) of every node at this level
+        gain_arr = gain_arr.at[heap_ids].set(
+            jnp.where(apply, gain.astype(jnp.float32), 0.0))
+        # unreachable children of non-split parents carry subtraction garbage
+        cover_arr = cover_arr.at[heap_ids].set(
+            jnp.where(child_valid, parent_c, 0.0).astype(jnp.float32))
 
         # advance rows whose node split. Two gather-free strategies (TPU
         # row-gathers over n are serial):
@@ -285,16 +296,22 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # leaf values from resting nodes (shrinkage applied here, like LightGBM);
     # segment sums and the delta lookup as one-hot matmuls, not scatters
     rest_oh = jax.nn.one_hot(node_of_row, cfg.max_nodes, dtype=jnp.float32)
-    gh = jnp.stack([grad, hess], axis=1)  # (n, 2)
+    cw = count_w if count_w is not None else jnp.ones(n, jnp.float32)
+    gh = jnp.stack([grad, hess, cw], axis=1)  # (n, 3)
     sums = psum(jax.lax.dot_general(rest_oh, gh, (((0,), (0,)), ((), ())),
                                     precision=jax.lax.Precision.HIGHEST))
-    seg_g, seg_h = sums[:, 0], sums[:, 1]
+    seg_g, seg_h, seg_c = sums[:, 0], sums[:, 1], sums[:, 2]
     leaf_value = (-cfg.learning_rate * _soft_threshold(seg_g, cfg.lambda_l1)
                   / (seg_h + cfg.lambda_l2 + 1e-12))
     leaf_value = jnp.where(seg_h > 0, leaf_value, 0.0)
+    # deepest-level nodes never get a parent_c pass; their cover is the
+    # resting-row count (internal levels keep the exact per-level counts)
+    last_base = 2 ** cfg.max_depth - 1
+    cover_arr = jnp.where(jnp.arange(cfg.max_nodes) >= last_base,
+                          seg_c.astype(jnp.float32), cover_arr)
 
     tree = Tree(split_feature=split_feature, split_bin=split_bin,
-                leaf_value=leaf_value)
+                leaf_value=leaf_value, gain=gain_arr, cover=cover_arr)
     delta = jnp.matmul(rest_oh, leaf_value[:, None],
                        precision=jax.lax.Precision.HIGHEST)[:, 0]
     return tree, delta

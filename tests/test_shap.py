@@ -1,0 +1,137 @@
+"""Exact TreeSHAP + gain importance tests.
+
+Oracle: brute-force path-dependent Shapley values — enumerate feature
+subsets, compute the tree's cover-weighted conditional expectation per
+subset, and apply the Shapley kernel directly. TreeSHAP must match this
+exactly (it is an exact algorithm, not an approximation).
+"""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.gbdt.booster import Booster, _tree_shap
+from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+
+
+def _expectation(sf, thr, lv, cover, x_row, subset, node=0):
+    """Path-dependent conditional expectation E[f | x_S] for one tree."""
+    f = sf[node]
+    if f < 0 or 2 * node + 2 >= len(sf):
+        return float(lv[node])
+    left, right = 2 * node + 1, 2 * node + 2
+    if f in subset:
+        nxt = left if x_row[f] <= thr[node] else right
+        return _expectation(sf, thr, lv, cover, x_row, subset, nxt)
+    cl, cr = float(cover[left]), float(cover[right])
+    tot = max(cl + cr, 1e-12)
+    return (cl / tot * _expectation(sf, thr, lv, cover, x_row, subset, left)
+            + cr / tot * _expectation(sf, thr, lv, cover, x_row, subset, right))
+
+
+def _brute_force_shap(sf, thr, lv, cover, x_row, n_features):
+    used = sorted(set(int(f) for f in sf if f >= 0))
+    phi = np.zeros(n_features + 1)
+    nf = len(used)
+    for f in used:
+        others = [u for u in used if u != f]
+        for r in range(len(others) + 1):
+            for s in itertools.combinations(others, r):
+                w = (math.factorial(len(s)) * math.factorial(nf - len(s) - 1)
+                     / math.factorial(nf))
+                phi[f] += w * (
+                    _expectation(sf, thr, lv, cover, x_row, set(s) | {f})
+                    - _expectation(sf, thr, lv, cover, x_row, set(s)))
+    phi[-1] = _expectation(sf, thr, lv, cover, x_row, set())
+    return phi
+
+
+def _train_small(seed=0, n=300, d=4, depth=3, iters=5, objective="regression"):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (2 * x[:, 0] - x[:, 1] + 0.5 * x[:, 0] * x[:, 2]
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    if objective == "binary":
+        y = (y > 0).astype(np.float32)
+    booster, _, _ = fit_booster(
+        x, y, BoostParams(objective=objective, num_iterations=iters,
+                          max_depth=depth, min_data_in_leaf=5, num_leaves=31))
+    return booster, x
+
+
+def test_tree_shap_matches_brute_force():
+    booster, x = _train_small()
+    assert booster.cover is not None
+    xq = x[:6]
+    for t in range(booster.n_trees):
+        got = _tree_shap(booster.split_feature[t], booster.threshold[t],
+                         booster.leaf_value[t], booster.cover[t], xq,
+                         booster.n_features)
+        for i in range(xq.shape[0]):
+            want = _brute_force_shap(booster.split_feature[t],
+                                     booster.threshold[t],
+                                     booster.leaf_value[t], booster.cover[t],
+                                     xq[i], booster.n_features)
+            np.testing.assert_allclose(got[i], want, rtol=1e-5, atol=1e-7)
+
+
+def test_shap_local_accuracy():
+    """sum(phi) + bias == raw prediction, exactly (SHAP's defining axiom)."""
+    booster, x = _train_small(seed=3, iters=10, depth=4)
+    contrib = booster.feature_contributions(x[:50])
+    raw = booster.raw_score(x[:50])[:, 0]
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-5)
+
+
+def test_shap_local_accuracy_binary():
+    booster, x = _train_small(seed=4, objective="binary", iters=8)
+    contrib = booster.feature_contributions(x[:30])
+    raw = booster.raw_score(x[:30])[:, 0]
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-5)
+
+
+def test_gain_importance_ranks_informative_features():
+    booster, x = _train_small(seed=5, iters=10)
+    gains = booster.feature_importances("gain")
+    # features 0/1/2 are informative, 3 is noise
+    assert gains[0] == gains.max()
+    assert gains[3] < gains[0] * 0.1
+    splits = booster.feature_importances("split")
+    assert splits.sum() == (booster.split_feature >= 0).sum()
+
+
+def test_covers_survive_roundtrip_and_merge():
+    booster, x = _train_small(seed=6, iters=4)
+    s = booster.save_model_string()
+    back = Booster.load_model_string(s)
+    np.testing.assert_allclose(back.cover, booster.cover, rtol=1e-6)
+    np.testing.assert_allclose(back.gain, booster.gain, rtol=1e-6)
+    merged = booster.merge(back)
+    assert merged.cover.shape[0] == 2 * booster.n_trees
+    # contributions still satisfy local accuracy after merge
+    contrib = merged.feature_contributions(x[:10])
+    raw = merged.raw_score(x[:10])[:, 0]
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-5)
+
+
+def test_root_cover_equals_row_count():
+    booster, x = _train_small(seed=7, n=256, iters=3)
+    np.testing.assert_allclose(booster.cover[:, 0], 256.0)
+
+
+def test_estimator_shap_col_includes_init_score():
+    """The estimator's SHAP column must sum to the FULL prediction,
+    including the boost_from_average base (LightGBM pred_contrib parity)."""
+    from mmlspark_tpu.models.gbdt import GBDTRegressor
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(300, 3)).astype(np.float32)
+    y = 5.0 + 2 * x[:, 0] + 0.1 * rng.normal(size=300)  # non-zero mean
+    t = Table({"features": x, "label": y})
+    m = GBDTRegressor(num_iterations=10, features_shap_col="shap").fit(t)
+    out = m.transform(t.take(40))
+    shap = np.asarray(out["shap"], np.float64)
+    np.testing.assert_allclose(shap.sum(axis=1),
+                               out[m.prediction_col][:40],
+                               rtol=1e-4, atol=1e-4)
