@@ -81,6 +81,14 @@ class Table:
 
     def __getitem__(self, name: str) -> np.ndarray:
         if name not in self._cols:
+            if f"{name}_idx" in self._cols and f"{name}_val" in self._cols:
+                raise KeyError(
+                    f"no column {name!r}, but the sparse pair "
+                    f"'{name}_idx'/'{name}_val' exists — this column was "
+                    f"produced in sparse form (featurizer dense_output "
+                    f"False/auto). Consume the pair (VW does natively), "
+                    f"densify via mmlspark_tpu.ops.sparse.to_dense, or set "
+                    f"dense_output=True on the featurizer.")
             raise KeyError(f"no column {name!r}; have {self.columns}")
         return self._cols[name]
 

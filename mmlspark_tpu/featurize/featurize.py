@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core import Estimator, Model, Param, Table, Transformer
+from ..core.params import one_of
 from ..ops.hashing import hash_strings
 from .clean_missing import CleanMissingData
 from .value_indexer import ValueIndexer
@@ -27,10 +28,19 @@ class Featurize(Estimator):
     one_hot_encode_categoricals = Param(
         "one_hot_encode_categoricals", "one-hot vs index for categoricals", True)
     num_features = Param("num_features",
-                         "hash slots for high-cardinality strings (0=auto)", 0)
+                         "hash slots for high-cardinality strings (0=auto: "
+                         "2^12 dense tree default; set 2^18 for the linear "
+                         "default, which auto-switches to sparse output)", 0)
     max_onehot_cardinality = Param(
         "max_onehot_cardinality", "index/one-hot below, hash above", 64)
     impute_missing = Param("impute_missing", "mean-impute numeric NaN", True)
+    dense_output = Param(
+        "dense_output",
+        "auto | True | False — False emits sparse pair columns "
+        "<out>_idx/<out>_val instead of a dense matrix; 'auto' goes sparse "
+        "when the assembled width exceeds 2^14 (each row's nnz is "
+        "schema-static, so the pair shape is (n, n_slots))", "auto",
+        validator=one_of("auto", True, False))
 
     def _fit(self, t: Table) -> "FeaturizeModel":
         cols = self.input_cols or [c for c in t.columns if c != self.label_col]
@@ -55,17 +65,52 @@ class Featurize(Estimator):
                     plans.append((c, "hash", nf_hash))
         imputer = (CleanMissingData(input_cols=imputer_cols).fit(t)
                    if imputer_cols else None)
-        m = FeaturizeModel(output_col=self.output_col)
+        m = FeaturizeModel(output_col=self.output_col,
+                           dense_output=self.dense_output)
         m._plans, m._imputer = plans, imputer
         return m
 
 
 class FeaturizeModel(Model):
     output_col = Param("output_col", "assembled features column", "features")
+    dense_output = Param("dense_output", "auto | True | False", "auto",
+                         validator=one_of("auto", True, False))
 
     def __init__(self, **kw):
         super().__init__(**kw)
         self._plans, self._imputer = [], None
+
+    # -- layout ------------------------------------------------------------
+    def _plan_widths(self):
+        """(logical width, slot count) per plan — a numeric/index/onehot/hash
+        plan touches exactly ONE slot per row; vectors touch their length."""
+        out = []
+        for c, kind, aux in self._plans:
+            if kind == "vector":
+                out.append((int(aux), int(aux)))
+            elif kind == "numeric":
+                out.append((1, 1))
+            elif kind == "index":
+                out.append((1, 1))
+            elif kind == "onehot":
+                out.append((len(aux._levels), 1))
+            elif kind == "hash":
+                out.append((int(aux), 1))
+        return out
+
+    @property
+    def num_output_features(self) -> int:
+        """Total logical feature width of the assembled vector."""
+        return sum(w for w, _ in self._plan_widths())
+
+    @property
+    def _dense(self) -> bool:
+        d = self.dense_output
+        if d is True:
+            return True
+        if d is False:
+            return False
+        return self.num_output_features <= (1 << 14)
 
     # persistence: encode plans as parallel object arrays + nested stages
     def _get_state(self):
@@ -112,31 +157,71 @@ class FeaturizeModel(Model):
     def _transform(self, t: Table) -> Table:
         if self._imputer is not None:
             t = self._imputer.transform(t)
-        blocks = []
-        for c, kind, aux in self._plans:
+        n = len(t)
+        if self._dense:
+            blocks = []
+            for c, kind, aux in self._plans:
+                arr = t[c]
+                if kind == "vector":
+                    blocks.append(np.asarray(arr, np.float32))
+                elif kind == "numeric":
+                    blocks.append(np.asarray(arr, np.float32)[:, None])
+                elif kind == "index":
+                    idx = np.asarray(aux.transform(t)[aux.output_col], np.float32)
+                    blocks.append(idx[:, None])
+                elif kind == "onehot":
+                    idx = np.asarray(aux.transform(t)[aux.output_col])
+                    k = len(aux._levels)
+                    oh = np.zeros((len(idx), k), np.float32)
+                    valid = idx >= 0
+                    oh[np.nonzero(valid)[0], idx[valid]] = 1.0
+                    blocks.append(oh)
+                elif kind == "hash":
+                    nf = aux
+                    h = hash_strings(arr.astype(str), num_bits=int(np.log2(nf)))
+                    hot = np.zeros((len(h), nf), np.float32)
+                    hot[np.arange(len(h)), h] = 1.0
+                    blocks.append(hot)
+            feats = (np.concatenate(blocks, axis=1) if blocks
+                     else np.zeros((n, 0), np.float32))
+            return t.with_column(self.output_col, feats)
+
+        # sparse pair output: one (idx, val) slot column group per plan,
+        # offset into the concatenated logical feature space — O(n * slots)
+        # memory regardless of num_features (2^18 hashing never materializes)
+        idx_parts, val_parts = [], []
+        offset = 0
+        for (c, kind, aux), (width, _) in zip(self._plans, self._plan_widths()):
             arr = t[c]
             if kind == "vector":
-                blocks.append(np.asarray(arr, np.float32))
+                idx_parts.append(np.broadcast_to(
+                    offset + np.arange(width, dtype=np.int32), (n, width)))
+                val_parts.append(np.asarray(arr, np.float32))
             elif kind == "numeric":
-                blocks.append(np.asarray(arr, np.float32)[:, None])
+                idx_parts.append(np.full((n, 1), offset, np.int32))
+                val_parts.append(np.asarray(arr, np.float32)[:, None])
             elif kind == "index":
-                idx = np.asarray(aux.transform(t)[aux.output_col], np.float32)
-                blocks.append(idx[:, None])
+                ix = np.asarray(aux.transform(t)[aux.output_col], np.float32)
+                idx_parts.append(np.full((n, 1), offset, np.int32))
+                val_parts.append(ix[:, None])
             elif kind == "onehot":
-                idx = np.asarray(aux.transform(t)[aux.output_col])
-                k = len(aux._levels)
-                oh = np.zeros((len(idx), k), np.float32)
-                valid = idx >= 0
-                oh[np.nonzero(valid)[0], idx[valid]] = 1.0
-                blocks.append(oh)
+                ix = np.asarray(aux.transform(t)[aux.output_col])
+                valid = ix >= 0
+                idx_parts.append((offset + np.clip(ix, 0, width - 1))
+                                 .astype(np.int32)[:, None])
+                val_parts.append(valid.astype(np.float32)[:, None])
             elif kind == "hash":
-                nf = aux
-                h = hash_strings(arr.astype(str), num_bits=int(np.log2(nf)))
-                hot = np.zeros((len(h), nf), np.float32)
-                hot[np.arange(len(h)), h] = 1.0
-                blocks.append(hot)
-        feats = np.concatenate(blocks, axis=1) if blocks else np.zeros((len(t), 0), np.float32)
-        return t.with_column(self.output_col, feats)
+                h = hash_strings(arr.astype(str),
+                                 num_bits=int(np.log2(aux)))
+                idx_parts.append((offset + h).astype(np.int32)[:, None])
+                val_parts.append(np.ones((n, 1), np.float32))
+            offset += width
+        o = self.output_col
+        return t.with_columns({
+            f"{o}_idx": np.concatenate(idx_parts, axis=1) if idx_parts
+            else np.zeros((n, 0), np.int32),
+            f"{o}_val": np.concatenate(val_parts, axis=1) if val_parts
+            else np.zeros((n, 0), np.float32)})
 
 
 class CountSelector(Estimator):

@@ -1,7 +1,12 @@
 """TextFeaturizer: tokenize -> n-grams -> hashed TF -> IDF in one estimator
 (reference: featurize/text/TextFeaturizer.scala builds the same SparkML
-pipeline). Hashing uses murmur3 (ops/hashing); TF/IDF vectors are dense f32
-rows sized 2^num_bits, ready for the device.
+pipeline). Hashing uses murmur3 (ops/hashing).
+
+Output layout: at the reference's 2^18 default a dense (n, 2^18) TF matrix
+is 1 MB/row — the sparse pair convention (ops/sparse.py) stores the same
+information as `<out>_idx`/`<out>_val` (n, max_tokens) instead. Dense output
+remains available (dense_output=True, or 'auto' under 2^14 slots) for
+consumers that want a matrix.
 """
 from __future__ import annotations
 
@@ -10,9 +15,31 @@ import re
 import numpy as np
 
 from ..core import Estimator, Model, Param, Table, HasInputCol, HasOutputCol
+from ..core.params import one_of
 from ..ops.hashing import hash_token
+from ..ops.sparse import rows_to_pair
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
+_DENSE_AUTO_LIMIT = 1 << 14
+
+
+class _TokenHashCache:
+    """Vectorized token hashing: murmur each UNIQUE token once per batch
+    (replaces the per-row per-token hot loop)."""
+
+    def __init__(self, mask: int):
+        self.mask = mask
+        self.cache: dict = {}
+
+    def __call__(self, tokens):
+        out = np.empty(len(tokens), np.int64)
+        for i, tok in enumerate(tokens):
+            h = self.cache.get(tok)
+            if h is None:
+                h = hash_token(tok) & self.mask
+                self.cache[tok] = h
+            out[i] = h
+        return out
 
 
 def _tokenize(text: str, to_lower=True):
@@ -39,16 +66,18 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     num_features = Param("num_features", "hash slots (power of two)", 1 << 18)
     use_idf = Param("use_idf", "apply inverse document frequency", True)
     min_doc_freq = Param("min_doc_freq", "min docs for a slot to keep idf", 1)
+    dense_output = Param("dense_output", "auto | True | False", "auto",
+                         validator=one_of("auto", True, False))
 
     def _slots(self, texts):
-        bits = int(np.log2(self.num_features))
-        mask = (1 << bits) - 1
+        mask = self.num_features - 1
+        hasher = _TokenHashCache(mask)
         rows = []
         for s in texts:
             toks = _tokenize(s, self.to_lower_case) if self.use_tokenizer else str(s).split()
             if self.use_ngram:
                 toks = _ngrams(toks, self.n_gram_length)
-            rows.append(np.asarray([hash_token(t) & mask for t in toks], np.int64))
+            rows.append(hasher(toks))
         return rows
 
     def _fit(self, t: Table) -> "TextFeaturizerModel":
@@ -76,6 +105,8 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
     num_features = Param("num_features", "hash slots (power of two)", 1 << 18)
     use_idf = Param("use_idf", "apply inverse document frequency", True)
     min_doc_freq = Param("min_doc_freq", "min docs for a slot to keep idf", 1)
+    dense_output = Param("dense_output", "auto | True | False", "auto",
+                         validator=one_of("auto", True, False))
 
     def __init__(self, **kw):
         super().__init__(**kw)
@@ -87,17 +118,29 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
     def _set_state(self, s):
         self._idf = np.asarray(s["idf"])
 
+    @property
+    def _dense(self) -> bool:
+        d = self.dense_output
+        return d is True or (d == "auto" and self.num_features <= _DENSE_AUTO_LIMIT)
+
     def _transform(self, t: Table) -> Table:
         nf = self.num_features
-        bits = int(np.log2(nf))
-        mask = (1 << bits) - 1
-        out = np.zeros((len(t), nf), np.float32)
-        for i, s in enumerate(t[self.input_col]):
+        mask = nf - 1
+        hasher = _TokenHashCache(mask)
+        rows_idx, rows_val = [], []
+        for s in t[self.input_col]:
             toks = _tokenize(s, self.to_lower_case) if self.use_tokenizer else str(s).split()
             if self.use_ngram:
                 toks = _ngrams(toks, self.n_gram_length)
-            for tok in toks:
-                out[i, hash_token(tok) & mask] += 1.0
-        if self.use_idf and self._idf is not None:
-            out *= self._idf[None, :]
-        return t.with_column(self.output_col, out)
+            slots, counts = np.unique(hasher(toks), return_counts=True)
+            tf = counts.astype(np.float32)
+            if self.use_idf and self._idf is not None:
+                tf = tf * self._idf[slots]
+            rows_idx.append(slots)
+            rows_val.append(tf)
+        idx, val = rows_to_pair(rows_idx, rows_val)
+        if self._dense:
+            from ..ops.sparse import to_dense
+            return t.with_column(self.output_col, to_dense(idx, val, nf))
+        return t.with_columns({f"{self.output_col}_idx": idx,
+                               f"{self.output_col}_val": val})

@@ -30,7 +30,7 @@ class _VWParamsMixin(HasFeaturesCol, HasLabelCol, HasWeightCol,
     initial_t = Param("initial_t", "lr schedule offset", 0.0)
     l1 = Param("l1", "L1 regularization", 0.0)
     l2 = Param("l2", "L2 regularization", 0.0)
-    mode = Param("mode", "sgd|adaptive|bfgs (VW --adaptive / --bfgs)", "sgd",
+    mode = Param("mode", "adaptive|sgd|bfgs (VW defaults to --adaptive)", "adaptive",
                  validator=one_of("sgd", "adaptive", "bfgs"))
     batch_size = Param("batch_size", "minibatch size (1 = exact VW serial)", 256)
     bfgs_iters = Param("bfgs_iters", "L-BFGS iterations", 25)

@@ -40,7 +40,7 @@ class VWParams:
     l2: float = 0.0
     num_passes: int = 1
     batch_size: int = 256
-    mode: str = "sgd"                # sgd | adaptive | bfgs
+    mode: str = "adaptive"           # adaptive (VW default) | sgd | bfgs
     bfgs_iters: int = 25
     bfgs_memory: int = 10
     seed: int = 0
@@ -60,7 +60,11 @@ def _pad_batches(idx, val, y, w, batch_size):
 
 
 def _predict_margin(weights, bias, idx, val):
-    # gather from the 2^b table; k is small (feature count), rows vectorize
+    # gather from the 2^b table; k is small (feature count), rows vectorize.
+    # indices are masked into the table like VW masks every hash (the
+    # feature space is DEFINED modulo 2^b, so out-of-range producers such as
+    # a Featurize layout wider than the table wrap instead of clamping)
+    idx = idx & (weights.shape[0] - 1)
     return jnp.sum(weights[idx] * val, axis=-1) + bias
 
 
@@ -93,7 +97,7 @@ def _fit_sgd(b_idx, b_val, b_y, b_w, p: VWParams, nb: int,
         margin = _predict_margin(weights, bias, idx, val)
         gm, loss = _loss_grad(margin, y, w, p.loss_function)
         # per-weight gradients via one segment_sum over the batch's slots
-        flat_idx = idx.reshape(-1)
+        flat_idx = (idx & (dim - 1)).reshape(-1)
         flat_g = (gm[:, None] * val).reshape(-1)
         gw = jax.ops.segment_sum(flat_g, flat_idx, num_segments=dim)
         denom = jnp.maximum(jnp.sum(w), 1.0)

@@ -1,0 +1,49 @@
+"""Static-width sparse feature pairs: the framework-wide sparse convention.
+
+A sparse feature column is a PAIR of dense arrays `<name>_idx` (n, W) int32
+and `<name>_val` (n, W) f32 with a schema-static width W; empty slots carry
+val 0 (their idx is irrelevant). This replaces Spark's boxed SparseVector
+(reference: featurize/Featurize.scala's hashing output, text
+TextFeaturizer's HashingTF vectors) with a shape XLA can consume directly:
+scatter/segment-sum over idx, no ragged rows, no host boxing. The VW
+learner's segment-sum SGD (models/vw/learner.py) consumes exactly this.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _densify(i, v, width):
+    import jax.numpy as jnp
+    n = i.shape[0]
+    out = jnp.zeros((n, width), jnp.float32)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], i.shape)
+    return out.at[rows, i].add(v)
+
+
+_densify_jit = None  # module-level jit: one compile per (shape, width)
+
+
+def to_dense(idx: np.ndarray, val: np.ndarray, width: int) -> np.ndarray:
+    """(n, W) sparse pair -> (n, width) dense f32, summing collisions.
+    Device-side segment-sum; use only when width is small enough to hold."""
+    import jax
+    import jax.numpy as jnp
+    global _densify_jit
+    if _densify_jit is None:
+        _densify_jit = jax.jit(_densify, static_argnames=("width",))
+    return np.asarray(_densify_jit(jnp.asarray(idx, jnp.int32),
+                                   jnp.asarray(val, jnp.float32), int(width)))
+
+
+def rows_to_pair(rows_idx, rows_val, min_width: int = 1):
+    """Ragged per-row (indices, values) lists -> padded (n, W) pair."""
+    n = len(rows_idx)
+    width = max(max((len(r) for r in rows_idx), default=0), min_width)
+    idx = np.zeros((n, width), np.int32)
+    val = np.zeros((n, width), np.float32)
+    for i, (ri, rv) in enumerate(zip(rows_idx, rows_val)):
+        k = len(ri)
+        idx[i, :k] = ri
+        val[i, :k] = rv
+    return idx, val

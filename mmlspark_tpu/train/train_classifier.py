@@ -40,7 +40,8 @@ class TrainClassifier(Estimator, HasLabelCol):
                 work = label_model.transform(t)
                 work = work.drop(self.label_col).rename(
                     {"__label_idx": self.label_col})
-        feat = Featurize(output_col=self.features_col,
+        feat = Featurize(dense_output=True,  # inner learners take matrices
+                         output_col=self.features_col,
                          label_col=self.label_col,
                          num_features=self.num_features).fit(work)
         featurized = feat.transform(work)
@@ -102,7 +103,8 @@ class TrainRegressor(Estimator, HasLabelCol):
         if inner is None:
             from ..models.linear import LinearRegression
             inner = LinearRegression()
-        feat = Featurize(output_col=self.features_col,
+        feat = Featurize(dense_output=True,  # inner learners take matrices
+                         output_col=self.features_col,
                          label_col=self.label_col,
                          num_features=self.num_features).fit(t)
         featurized = feat.transform(t)

@@ -188,3 +188,99 @@ def test_find_best_model(mixed_table):
     assert bm.best_model is models[1]  # more trees wins on train eval
     res = bm.get_evaluation_results()
     assert len(res) == 2
+
+
+def test_featurize_sparse_matches_dense():
+    """Sparse pair output densifies to exactly the dense-path matrix."""
+    from mmlspark_tpu.featurize.featurize import Featurize
+    from mmlspark_tpu.ops.sparse import to_dense
+    rng = np.random.default_rng(0)
+    t = Table({
+        "num": rng.normal(size=40),
+        "vec": rng.normal(size=(40, 3)),
+        "cat": np.array(rng.choice(["a", "b", "c"], 40), dtype=object),
+        "label": rng.integers(0, 2, 40),
+    })
+    dense_m = Featurize(dense_output=True).fit(t)
+    dense = dense_m.transform(t)["features"]
+    sparse_m = Featurize(dense_output=False).fit(t)
+    out = sparse_m.transform(t)
+    assert "features_idx" in out and "features_val" in out
+    got = to_dense(out["features_idx"], out["features_val"],
+                   sparse_m.num_output_features)
+    np.testing.assert_allclose(got, dense, rtol=1e-6)
+
+
+def test_featurize_2pow18_no_oom():
+    """Hashing at the reference's 2^18 linear default must not materialize
+    a dense (n, 262144) matrix (VERDICT weakness #6)."""
+    from mmlspark_tpu.featurize.featurize import Featurize
+    n = 5000
+    rng = np.random.default_rng(1)
+    t = Table({
+        "id": np.array([f"user_{i}" for i in rng.integers(0, 100000, n)],
+                       dtype=object),
+        "x": rng.normal(size=n),
+        "label": rng.integers(0, 2, n),
+    })
+    m = Featurize(num_features=1 << 18, max_onehot_cardinality=8).fit(t)
+    out = m.transform(t)  # auto -> sparse (width > 2^14)
+    assert "features_idx" in out.columns
+    assert out["features_idx"].shape == (n, 2)  # one hash + one numeric slot
+    assert m.num_output_features == (1 << 18) + 1
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        m.save(os.path.join(d, "m"))
+        m2 = type(m).load(os.path.join(d, "m"))
+        out2 = m2.transform(t)
+        np.testing.assert_array_equal(out2["features_idx"], out["features_idx"])
+
+
+def test_text_featurizer_sparse_at_default_width():
+    """TextFeaturizer at its 2^18 default emits sparse pairs and the IDF'd
+    values match the small-width dense path's nonzeros."""
+    from mmlspark_tpu.featurize.text import TextFeaturizer
+    from mmlspark_tpu.ops.sparse import to_dense
+    docs = Table({"text": np.array(
+        ["the cat sat on the mat", "a dog ate the cat food", "mat cat dog"],
+        dtype=object)})
+    big = TextFeaturizer(input_col="text", num_features=1 << 18).fit(docs)
+    out = big.transform(docs)
+    assert "output_idx" in out.columns  # sparse at 2^18
+    assert out["output_idx"].shape[1] <= 6
+    # dense/sparse equivalence at a small width
+    small_d = TextFeaturizer(input_col="text", num_features=256,
+                             dense_output=True).fit(docs)
+    small_s = TextFeaturizer(input_col="text", num_features=256,
+                             dense_output=False).fit(docs)
+    dd = small_d.transform(docs)["output"]
+    ss = small_s.transform(docs)
+    np.testing.assert_allclose(
+        to_dense(ss["output_idx"], ss["output_val"], 256), dd, rtol=1e-6)
+
+
+def test_sparse_pair_keyerror_is_actionable():
+    """Reading the dense column of a sparse-form featurization must explain
+    the pair convention instead of a bare missing-column error."""
+    from mmlspark_tpu.featurize.featurize import Featurize
+    t = Table({"id": np.array([f"u{i}" for i in range(200)], dtype=object),
+               "label": np.zeros(200)})
+    m = Featurize(num_features=1 << 18, max_onehot_cardinality=8).fit(t)
+    out = m.transform(t)
+    with pytest.raises(KeyError, match="dense_output"):
+        out["features"]
+
+
+def test_train_classifier_stays_dense_at_high_num_features():
+    """Train* wrappers pin dense featurization — inner learners take
+    matrices, so the sparse auto-switch must not change their schema."""
+    rng = np.random.default_rng(3)
+    t = Table({
+        "city": np.array([f"c{i}" for i in rng.integers(0, 500, 300)],
+                         dtype=object),
+        "x": rng.normal(size=300),
+        "label": rng.integers(0, 2, 300).astype(np.float64),
+    })
+    m = TrainClassifier(num_features=1 << 15).fit(t)
+    out = m.transform(t)
+    assert "scored_labels" in out.columns

@@ -95,7 +95,8 @@ def test_murmur_known_vectors():
 # ----------------------------------------------------------------- regressor
 def test_regressor_plain_sgd(energy_like):
     model, out = fuzz_estimator(
-        VowpalWabbitRegressor(num_passes=30, learning_rate=0.5, num_tasks=1),
+        VowpalWabbitRegressor(num_passes=30, learning_rate=0.5, num_tasks=1,
+                              mode="sgd"),
         energy_like)
     y = np.asarray(energy_like["label"])
     mse = float(np.mean((np.asarray(out["prediction"]) - y) ** 2))
@@ -218,3 +219,35 @@ def test_contextual_bandit():
     assert policy_cost < uniform_cost - 0.3 * (uniform_cost - best_cost)
     assert "ips_estimate" in m._stats and "snips_estimate" in m._stats
     fuzz_estimator(cb, t)
+
+
+def test_high_cardinality_sparse_features_learnable():
+    """Rare hashed features (few examples each) must be learnable with the
+    default mode — VW's real default is --adaptive, and plain minibatch SGD's
+    bias updates swamp per-example weight updates at high cardinality."""
+    rng = np.random.default_rng(0)
+    n = 8000
+    ids = rng.integers(0, 2000, n)
+    t = Table({"features_idx": ids[:, None].astype(np.int32),
+               "features_val": np.ones((n, 1), np.float32),
+               "label": (ids % 2).astype(np.float64)})
+    m = VowpalWabbitClassifier(features_col="features", num_passes=8).fit(t)
+    acc = (m.transform(t)["prediction"] == t["label"]).mean()
+    assert acc > 0.95, acc
+
+
+def test_out_of_range_indices_wrap_like_vw():
+    """Indices beyond 2^num_bits mask into the table (VW hash semantics)
+    instead of clamping/dropping."""
+    t_lo = Table({"features_idx": np.array([[5]], np.int32),
+                  "features_val": np.ones((1, 1), np.float32),
+                  "label": np.array([1.0])})
+    n_bits = 10
+    hi = 5 + (1 << n_bits)  # wraps to slot 5
+    m = VowpalWabbitRegressor(features_col="features", num_bits=n_bits,
+                              num_passes=4).fit(t_lo)
+    t_hi = Table({"features_idx": np.array([[hi]], np.int32),
+                  "features_val": np.ones((1, 1), np.float32),
+                  "label": np.array([1.0])})
+    np.testing.assert_allclose(m.transform(t_hi)["prediction"],
+                               m.transform(t_lo)["prediction"], rtol=1e-6)
