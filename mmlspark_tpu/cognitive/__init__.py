@@ -1,0 +1,12 @@
+"""Cognitive-service clients (reference: cognitive/ — SURVEY.md §2.8)."""
+from .base import CognitiveServiceBase
+from .services import (AnalyzeImage, BingImageSearch, DescribeImage,
+                       DetectEntireSeriesAnomalies, DetectFace,
+                       DetectLastAnomaly, OCR)
+from .text_analytics import (EntityDetector, KeyPhraseExtractor,
+                             LanguageDetector, NER, TextSentiment)
+
+__all__ = ["AnalyzeImage", "BingImageSearch", "CognitiveServiceBase",
+           "DescribeImage", "DetectEntireSeriesAnomalies", "DetectFace",
+           "DetectLastAnomaly", "EntityDetector", "KeyPhraseExtractor",
+           "LanguageDetector", "NER", "OCR", "TextSentiment"]

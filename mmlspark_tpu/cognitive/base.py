@@ -1,0 +1,117 @@
+"""Cognitive-service client base.
+
+Role-equivalent to the reference's CognitiveServiceBase.scala:232-297: each
+service is a Transformer that packs per-row dynamic params into a request,
+runs the shared async HTTP client with the advanced retry/backoff/429
+handler, and parses the JSON response into an output column + an error
+column. The reference composes Lambda -> SimpleHTTPTransformer ->
+DropColumns (getInternalTransformer); here the same composition is direct
+function calls over Table columns.
+
+Service params follow the reference's VectorizableParam convention: each can
+be a STATIC value (set_x) or read per-row from a COLUMN (set_x_col) —
+`_service_value(t, name)` resolves either into a per-row sequence.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from ..core import Param, Table, Transformer
+from ..core.params import HasOutputCol, in_range
+from ..io.http import (HTTPRequest, HTTPResponse, HTTPTransformer,
+                       JSONOutputParser)
+
+
+class HasServiceParams:
+    """Mixin: resolve value-or-column service params
+    (reference: HasServiceParams / VectorizableParam, CognitiveServiceBase.scala:44-120)."""
+
+    def _service_value(self, t: Table, name: str):
+        """Per-row values for service param `name`: the column named by
+        `<name>_col` when set, else the static param broadcast to all rows."""
+        col_param = f"{name}_col"
+        if self.has_param(col_param) and self.get(col_param):
+            return t[self.get(col_param)]
+        val = self.get_or_default(name)
+        return [val] * len(t)
+
+
+class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
+    """Shared plumbing: auth header, batched POST, response routing
+    (reference: CognitiveServicesBase, CognitiveServiceBase.scala:232-297)."""
+    url = Param("url", "full endpoint URL", None)
+    subscription_key = Param("subscription_key", "Ocp-Apim key", None)
+    subscription_key_col = Param("subscription_key_col",
+                                 "per-row key column", None)
+    error_col = Param("error_col", "column for HTTP/service errors", "errors")
+    concurrency = Param("concurrency", "max in-flight requests", 1,
+                        validator=in_range(1))
+    timeout = Param("timeout", "per-request timeout (s)", 60.0)
+    retry_times = Param("retry_times", "advanced-handler retries", 3)
+    backoff = Param("backoff", "advanced-handler initial backoff (s)", 0.05)
+
+    # -- request construction (per service) ---------------------------------
+    def _build_requests(self, t: Table) -> list:
+        raise NotImplementedError
+
+    def _parse_response(self, resp_json, row_count: int) -> list:
+        """Service JSON -> per-row output values."""
+        raise NotImplementedError
+
+    def _headers(self, key: Optional[str]) -> dict:
+        h = {"Content-Type": "application/json"}
+        if key:
+            h["Ocp-Apim-Subscription-Key"] = key
+        return h
+
+    def _transform(self, t: Table) -> Table:
+        reqs = self._build_requests(t)
+        req_col = t.find_unused_column_name("__cog_req")
+        resp_col = t.find_unused_column_name("__cog_resp")
+        reqs_arr = np.empty(len(reqs), dtype=object)
+        reqs_arr[:] = reqs
+        # requests may be batched: fewer requests than rows (TextAnalytics
+        # sends up to batch_size documents per call, TextAnalytics.scala)
+        rt = Table({req_col: reqs_arr})
+        client = HTTPTransformer(
+            input_col=req_col, output_col=resp_col,
+            concurrency=self.concurrency, handler="advanced",
+            timeout=self.timeout, retry_times=self.retry_times,
+            backoff=self.backoff)
+        responses = client.transform(rt)[resp_col]
+        outputs, errors = self._route(responses, len(t))
+        out_arr = np.empty(len(t), dtype=object)
+        out_arr[:] = outputs
+        err_arr = np.empty(len(t), dtype=object)
+        err_arr[:] = errors
+        return t.with_columns({self.output_col: out_arr,
+                               self.error_col: err_arr})
+
+    def _route(self, responses, n_rows: int):
+        """Distribute batched responses back onto rows."""
+        outputs: list = [None] * n_rows
+        errors: list = [None] * n_rows
+        spans = self._request_row_spans(n_rows)
+        for resp, (lo, hi) in zip(responses, spans):
+            if resp is None or resp.status != 200:
+                msg = (f"HTTP {resp.status}: {resp.error or resp.reason}"
+                       if resp is not None else "no response")
+                for i in range(lo, hi):
+                    errors[i] = msg
+                continue
+            try:
+                vals = self._parse_response(resp.json(), hi - lo)
+            except ValueError as e:
+                for i in range(lo, hi):
+                    errors[i] = f"bad JSON: {e}"
+                continue
+            for i, v in zip(range(lo, hi), vals):
+                outputs[i] = v
+        return outputs, errors
+
+    def _request_row_spans(self, n_rows: int):
+        """Row range each request covers; default 1:1."""
+        return [(i, i + 1) for i in range(n_rows)]

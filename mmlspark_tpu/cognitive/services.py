@@ -1,0 +1,151 @@
+"""Anomaly detection, vision, face, and image-search clients (reference:
+cognitive/AnamolyDetection.scala, ComputerVision.scala, Face.scala,
+BingImageSearch.scala). Each service builds its documented request payload
+and extracts its documented response shape; transport/retry/auth live in
+CognitiveServiceBase."""
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+import numpy as np
+
+from ..core import Param, Table
+from ..core.params import HasInputCol, one_of
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+
+class _AnomalyBase(CognitiveServiceBase):
+    """Series-per-row anomaly detection (reference: AnomalyDetectorBase —
+    the series column holds [{timestamp, value}, ...] per row)."""
+    series_col = Param("series_col", "column of [{timestamp, value}] series",
+                       "series")
+    granularity = Param("granularity", "timestamp granularity", "monthly",
+                        validator=one_of("yearly", "monthly", "weekly",
+                                         "daily", "hourly", "minutely",
+                                         "secondly"))
+    max_anomaly_ratio = Param("max_anomaly_ratio", "expected anomaly ratio",
+                              0.25)
+    sensitivity = Param("sensitivity", "detection sensitivity 0-99", 95)
+
+    def _build_requests(self, t: Table):
+        keys = self._service_value(t, "subscription_key")
+        reqs = []
+        for i, series in enumerate(t[self.series_col]):
+            body = {"series": list(series),
+                    "granularity": self.granularity,
+                    "maxAnomalyRatio": self.max_anomaly_ratio,
+                    "sensitivity": self.sensitivity}
+            reqs.append(HTTPRequest(url=self.url, method="POST",
+                                    headers=self._headers(keys[i]),
+                                    body=json.dumps(body).encode()))
+        return reqs
+
+    def _parse_response(self, payload, row_count: int):
+        return [payload]
+
+
+class DetectEntireSeriesAnomalies(_AnomalyBase):
+    """POST .../timeseries/entire/detect (reference: DetectAnomalies):
+    response carries isAnomaly[] / expectedValues[] per point."""
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    """POST .../timeseries/last/detect (reference: DetectLastAnomaly):
+    response carries isAnomaly for the final point."""
+
+
+class _ImageUrlService(CognitiveServiceBase, HasInputCol):
+    """Vision services that POST {"url": <image url>} (reference:
+    ComputerVision.scala HasImageUrl)."""
+    input_col = Param("input_col", "image-url column", "image")
+    _extra_query: dict = {}
+
+    def _build_requests(self, t: Table):
+        keys = self._service_value(t, "subscription_key")
+        url = self.url
+        if self._query_params():
+            url = url + "?" + urllib.parse.urlencode(self._query_params())
+        return [HTTPRequest(url=url, method="POST",
+                            headers=self._headers(keys[i]),
+                            body=json.dumps({"url": str(v)}).encode())
+                for i, v in enumerate(t[self.input_col])]
+
+    def _query_params(self) -> dict:
+        return dict(self._extra_query)
+
+    def _parse_response(self, payload, row_count: int):
+        return [payload]
+
+
+class OCR(_ImageUrlService):
+    """Printed-text OCR (reference: OCR, ComputerVision.scala): response
+    regions/lines/words."""
+    detect_orientation = Param("detect_orientation", "auto-rotate", True)
+
+    def _query_params(self):
+        return {"detectOrientation": str(bool(self.detect_orientation)).lower()}
+
+
+class AnalyzeImage(_ImageUrlService):
+    """Image analysis (reference: AnalyzeImage): visualFeatures/details query."""
+    visual_features = Param("visual_features", "features to compute",
+                            None)
+    details = Param("details", "extra detail domains", None)
+
+    def _query_params(self):
+        q = {}
+        if self.visual_features:
+            q["visualFeatures"] = ",".join(self.visual_features)
+        if self.details:
+            q["details"] = ",".join(self.details)
+        return q
+
+
+class DescribeImage(_ImageUrlService):
+    """Caption generation (reference: DescribeImage)."""
+    max_candidates = Param("max_candidates", "captions to return", 1)
+
+    def _query_params(self):
+        return {"maxCandidates": str(self.max_candidates)}
+
+
+class DetectFace(_ImageUrlService):
+    """Face detection (reference: DetectFace, Face.scala): returns face
+    rectangles + requested attributes."""
+    return_face_attributes = Param("return_face_attributes",
+                                   "attribute list", None)
+
+    def _query_params(self):
+        q = {"returnFaceId": "true"}
+        if self.return_face_attributes:
+            q["returnFaceAttributes"] = ",".join(self.return_face_attributes)
+        return q
+
+
+class BingImageSearch(CognitiveServiceBase, HasInputCol):
+    """Image search: GET with q= (reference: BingImageSearch.scala)."""
+    input_col = Param("input_col", "query-text column", "q")
+    count = Param("count", "results per query", 10)
+    offset = Param("offset", "result offset", 0)
+
+    def _build_requests(self, t: Table):
+        keys = self._service_value(t, "subscription_key")
+        return [HTTPRequest(
+            url=self.url + "?" + urllib.parse.urlencode(
+                {"q": str(q), "count": self.count, "offset": self.offset}),
+            method="GET", headers=self._headers(keys[i]))
+            for i, q in enumerate(t[self.input_col])]
+
+    def _parse_response(self, payload, row_count: int):
+        return [payload.get("value", payload)]
+
+    @staticmethod
+    def get_urls(t: Table, search_col: str, url_col: str = "imageUrl") -> Table:
+        """Explode contentUrls out of search results (reference:
+        BingImageSearch.getUrlTransformer)."""
+        urls = []
+        for row in t[search_col]:
+            urls.extend(item.get("contentUrl") for item in (row or []))
+        return Table({url_col: np.asarray(urls, dtype=object)})

@@ -1,0 +1,86 @@
+"""Text Analytics clients (reference: cognitive/TextAnalytics.scala +
+TextAnalyticsSchemas.scala): sentiment, language detection, entities, NER,
+key phrases. Documents are batched `batch_size` rows per request exactly like
+the reference's TADocument batching, ids are row offsets, and per-document
+errors land in the error column while good rows still score."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core import Param, Table
+from ..core.params import HasInputCol, in_range
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+
+class _TextAnalyticsBase(CognitiveServiceBase, HasInputCol):
+    language = Param("language", "static document language", "en")
+    language_col = Param("language_col", "per-row language column", None)
+    batch_size = Param("batch_size", "documents per request", 25,
+                       validator=in_range(1))
+
+    # subclasses: path + the field extracted from each response document
+    _doc_field = "score"
+
+    def _request_row_spans(self, n_rows: int):
+        b = self.batch_size
+        return [(lo, min(lo + b, n_rows)) for lo in range(0, n_rows, b)]
+
+    def _build_requests(self, t: Table):
+        texts = t[self.input_col]
+        langs = self._service_value(t, "language")
+        keys = self._service_value(t, "subscription_key")
+        reqs = []
+        for lo, hi in self._request_row_spans(len(t)):
+            docs = [{"id": str(i - lo), "language": str(langs[i]),
+                     "text": str(texts[i])} for i in range(lo, hi)]
+            reqs.append(HTTPRequest(
+                url=self.url, method="POST",
+                headers=self._headers(keys[lo]),
+                body=json.dumps({"documents": docs}).encode()))
+        return reqs
+
+    def _parse_response(self, payload, row_count: int):
+        by_id = {str(d.get("id")): d for d in payload.get("documents", [])}
+        err_by_id = {str(e.get("id")): e for e in payload.get("errors", [])}
+        out = []
+        for i in range(row_count):
+            doc = by_id.get(str(i))
+            if doc is not None:
+                out.append(self._extract(doc))
+            elif str(i) in err_by_id:
+                out.append(None)
+            else:
+                out.append(None)
+        return out
+
+    def _extract(self, doc: dict):
+        return doc.get(self._doc_field)
+
+
+class TextSentiment(_TextAnalyticsBase):
+    """Sentiment score per document (reference: TextSentiment,
+    TextAnalytics.scala)."""
+    _doc_field = "score"
+
+
+class LanguageDetector(_TextAnalyticsBase):
+    """Detected languages (reference: LanguageDetector)."""
+    _doc_field = "detectedLanguages"
+
+
+class EntityDetector(_TextAnalyticsBase):
+    """Linked entities (reference: EntityDetector)."""
+    _doc_field = "entities"
+
+
+class NER(_TextAnalyticsBase):
+    """Named entities (reference: NER / NERV2)."""
+    _doc_field = "entities"
+
+
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    """Key phrases (reference: KeyPhraseExtractor)."""
+    _doc_field = "keyPhrases"

@@ -33,8 +33,14 @@ class Table:
     between stages lazily; `materialize()` is the explicit host sync.
     """
 
-    def __init__(self, data: dict, npartitions: int = 1):
+    def __init__(self, data: dict, npartitions: int = 1,
+                 meta: dict = None):
         self._cols: dict[str, np.ndarray] = {}
+        # per-column metadata (categorical levels etc. — the role of Spark
+        # column Metadata in core/schema/Categoricals.scala); carried
+        # best-effort through functional updates
+        self._meta: dict[str, dict] = {k: dict(v)
+                                       for k, v in (meta or {}).items()}
         nrows = None
         for name, col in data.items():
             # jax device arrays are kept as-is — stages can hand results
@@ -49,6 +55,9 @@ class Table:
                     f"column {name!r} has {arr.shape[0]} rows, expected {nrows}")
             self._cols[name] = arr
         self._nrows = nrows or 0
+        # metadata only for columns that actually exist — drop/select prune
+        # stale entries by construction
+        self._meta = {k: v for k, v in self._meta.items() if k in self._cols}
         if npartitions < 1:
             raise ValueError("npartitions must be >= 1")
         self.npartitions = int(npartitions)
@@ -72,6 +81,23 @@ class Table:
 
     def schema(self) -> dict:
         return {n: (c.dtype, c.shape[1:]) for n, c in self._cols.items()}
+
+    # -- per-column metadata (reference: core/schema/Categoricals.scala) ----
+    def column_meta(self, name: str) -> dict:
+        return dict(self._meta.get(name, {}))
+
+    def with_column_meta(self, name: str, **entries) -> "Table":
+        """Attach metadata entries to a column (e.g. categorical levels —
+        the role of CategoricalColumnInfo on Spark column Metadata)."""
+        if name not in self._cols:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        meta = {k: dict(v) for k, v in self._meta.items()}
+        meta.setdefault(name, {}).update(entries)
+        return Table(self._cols, self.npartitions, meta=meta)
+
+    def categorical_levels(self, name: str):
+        """Levels recorded for a categorical column, or None."""
+        return self._meta.get(name, {}).get("categorical_levels")
 
     def __contains__(self, name: str) -> bool:
         return name in self._cols
@@ -103,7 +129,10 @@ class Table:
                 f"new column {name!r} has {arr.shape[0]} rows, table has {self._nrows}")
         data = dict(self._cols)
         data[name] = arr
-        return Table(data, self.npartitions)
+        # a REPLACED column's old metadata no longer describes its contents
+        meta = ({k: v for k, v in self._meta.items() if k != name}
+                if name in self._cols else self._meta)
+        return Table(data, self.npartitions, meta=meta)
 
     def with_columns(self, cols: dict) -> "Table":
         out = self
@@ -112,28 +141,34 @@ class Table:
         return out
 
     def select(self, names: Sequence[str]) -> "Table":
-        return Table({n: self._cols[n] for n in names}, self.npartitions)
+        return Table({n: self._cols[n] for n in names}, self.npartitions,
+                     meta=self._meta)
 
     def drop(self, *names: str) -> "Table":
         return Table({n: c for n, c in self._cols.items() if n not in names},
-                     self.npartitions)
+                     self.npartitions, meta=self._meta)
 
     def rename(self, mapping: dict) -> "Table":
         return Table({mapping.get(n, n): c for n, c in self._cols.items()},
-                     self.npartitions)
+                     self.npartitions,
+                     meta={mapping.get(n, n): m
+                           for n, m in self._meta.items()})
 
     def filter(self, mask) -> "Table":
         mask = np.asarray(mask)
-        return Table({n: c[mask] for n, c in self._cols.items()}, self.npartitions)
+        return Table({n: c[mask] for n, c in self._cols.items()},
+                     self.npartitions, meta=self._meta)
 
     def take(self, n: int) -> "Table":
-        return Table({k: c[:n] for k, c in self._cols.items()}, self.npartitions)
+        return Table({k: c[:n] for k, c in self._cols.items()},
+                     self.npartitions, meta=self._meta)
 
     def concat(self, other: "Table") -> "Table":
         if set(other.columns) != set(self.columns):
             raise ValueError("schema mismatch in concat")
         return Table({n: np.concatenate([self._cols[n], other._cols[n]])
-                      for n in self.columns}, self.npartitions)
+                      for n in self.columns}, self.npartitions,
+                     meta=self._meta)
 
     @staticmethod
     def concat_all(tables: Sequence["Table"]) -> "Table":
@@ -141,11 +176,12 @@ class Table:
             raise ValueError("empty concat")
         first = tables[0]
         return Table({n: np.concatenate([t[n] for t in tables])
-                      for n in first.columns}, first.npartitions)
+                      for n in first.columns}, first.npartitions,
+                     meta=first._meta)
 
     # -- partitioning (partition-as-device) ----------------------------------
     def repartition(self, npartitions: int) -> "Table":
-        return Table(self._cols, npartitions)
+        return Table(self._cols, npartitions, meta=self._meta)
 
     def partition_bounds(self) -> list:
         """Row ranges per partition; contiguous row blocks like Spark's coalesce."""
@@ -154,11 +190,13 @@ class Table:
 
     def partitions(self) -> Iterable["Table"]:
         for lo, hi in self.partition_bounds():
-            yield Table({n: c[lo:hi] for n, c in self._cols.items()}, 1)
+            yield Table({n: c[lo:hi] for n, c in self._cols.items()}, 1,
+                        meta=self._meta)
 
     def partition(self, i: int) -> "Table":
         lo, hi = self.partition_bounds()[i]
-        return Table({n: c[lo:hi] for n, c in self._cols.items()}, 1)
+        return Table({n: c[lo:hi] for n, c in self._cols.items()}, 1,
+                     meta=self._meta)
 
     def map_partitions(self, fn: Callable[["Table"], "Table"]) -> "Table":
         """Host-side per-partition map (IO / serving stages). Numeric stages
@@ -166,12 +204,13 @@ class Table:
         parts = [fn(p) for p in self.partitions()]
         parts = [p for p in parts if p is not None and len(p.columns)]
         out = Table.concat_all(parts)
-        return Table(out._cols, self.npartitions)
+        return Table(out._cols, self.npartitions, meta=out._meta)
 
     def shuffle(self, seed: int = 0) -> "Table":
         rng = np.random.default_rng(seed)
         perm = rng.permutation(self._nrows)
-        return Table({n: c[perm] for n, c in self._cols.items()}, self.npartitions)
+        return Table({n: c[perm] for n, c in self._cols.items()},
+                     self.npartitions, meta=self._meta)
 
     def split(self, fraction: float, seed: int = 0):
         """Random (train, test) split."""
@@ -179,15 +218,18 @@ class Table:
         perm = rng.permutation(self._nrows)
         k = int(round(self._nrows * fraction))
         a, b = perm[:k], perm[k:]
-        return (Table({n: c[a] for n, c in self._cols.items()}, self.npartitions),
-                Table({n: c[b] for n, c in self._cols.items()}, self.npartitions))
+        return (Table({n: c[a] for n, c in self._cols.items()},
+                      self.npartitions, meta=self._meta),
+                Table({n: c[b] for n, c in self._cols.items()},
+                      self.npartitions, meta=self._meta))
 
     def materialize(self) -> "Table":
         """Force every column to a concrete host numpy array — the
         materialization barrier Cacher/Timer use; jax device columns
         transfer and sync here."""
         return Table({n: c if isinstance(c, np.ndarray) else np.asarray(c)
-                      for n, c in self._cols.items()}, self.npartitions)
+                      for n, c in self._cols.items()}, self.npartitions,
+                     meta=self._meta)
 
     # -- misc ----------------------------------------------------------------
     def find_unused_column_name(self, prefix: str) -> str:

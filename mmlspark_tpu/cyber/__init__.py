@@ -1,0 +1,11 @@
+"""CyberML (reference: mmlspark/cyber — SURVEY.md §2.8)."""
+from .access_anomaly import (AccessAnomaly, AccessAnomalyModel,
+                             ComplementAccessTransformer)
+from .features import (IdIndexer, IdIndexerModel, LinearScalarScaler,
+                       LinearScalarScalerModel, StandardScalarScaler,
+                       StandardScalarScalerModel)
+
+__all__ = ["AccessAnomaly", "AccessAnomalyModel",
+           "ComplementAccessTransformer", "IdIndexer", "IdIndexerModel",
+           "LinearScalarScaler", "LinearScalarScalerModel",
+           "StandardScalarScaler", "StandardScalarScalerModel"]

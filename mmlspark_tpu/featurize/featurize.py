@@ -14,6 +14,7 @@ import numpy as np
 from ..core import Estimator, Model, Param, Table, Transformer
 from ..core.params import one_of
 from ..ops.hashing import hash_strings
+from ..ops.sparse import DENSE_AUTO_LIMIT
 from .clean_missing import CleanMissingData
 from .value_indexer import ValueIndexer
 
@@ -82,26 +83,24 @@ class FeaturizeModel(Model):
 
     # -- layout ------------------------------------------------------------
     def _plan_widths(self):
-        """(logical width, slot count) per plan — a numeric/index/onehot/hash
-        plan touches exactly ONE slot per row; vectors touch their length."""
+        """Logical feature width per plan (vectors: length; numeric/index: 1;
+        onehot: level count; hash: table size)."""
         out = []
         for c, kind, aux in self._plans:
             if kind == "vector":
-                out.append((int(aux), int(aux)))
-            elif kind == "numeric":
-                out.append((1, 1))
-            elif kind == "index":
-                out.append((1, 1))
+                out.append(int(aux))
+            elif kind in ("numeric", "index"):
+                out.append(1)
             elif kind == "onehot":
-                out.append((len(aux._levels), 1))
+                out.append(len(aux._levels))
             elif kind == "hash":
-                out.append((int(aux), 1))
+                out.append(int(aux))
         return out
 
     @property
     def num_output_features(self) -> int:
         """Total logical feature width of the assembled vector."""
-        return sum(w for w, _ in self._plan_widths())
+        return sum(self._plan_widths())
 
     @property
     def _dense(self) -> bool:
@@ -110,7 +109,7 @@ class FeaturizeModel(Model):
             return True
         if d is False:
             return False
-        return self.num_output_features <= (1 << 14)
+        return self.num_output_features <= DENSE_AUTO_LIMIT
 
     # persistence: encode plans as parallel object arrays + nested stages
     def _get_state(self):
@@ -191,7 +190,7 @@ class FeaturizeModel(Model):
         # memory regardless of num_features (2^18 hashing never materializes)
         idx_parts, val_parts = [], []
         offset = 0
-        for (c, kind, aux), (width, _) in zip(self._plans, self._plan_widths()):
+        for (c, kind, aux), width in zip(self._plans, self._plan_widths()):
             arr = t[c]
             if kind == "vector":
                 idx_parts.append(np.broadcast_to(

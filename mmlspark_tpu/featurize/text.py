@@ -17,10 +17,9 @@ import numpy as np
 from ..core import Estimator, Model, Param, Table, HasInputCol, HasOutputCol
 from ..core.params import one_of
 from ..ops.hashing import hash_token
-from ..ops.sparse import rows_to_pair
+from ..ops.sparse import DENSE_AUTO_LIMIT, rows_to_pair
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
-_DENSE_AUTO_LIMIT = 1 << 14
 
 
 class _TokenHashCache:
@@ -121,7 +120,7 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
     @property
     def _dense(self) -> bool:
         d = self.dense_output
-        return d is True or (d == "auto" and self.num_features <= _DENSE_AUTO_LIMIT)
+        return d is True or (d == "auto" and self.num_features <= DENSE_AUTO_LIMIT)
 
     def _transform(self, t: Table) -> Table:
         nf = self.num_features

@@ -7,6 +7,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core import (Estimator, Model, Param, Table, HasInputCol, HasOutputCol)
+from ..ops.levels import lookup_levels
 
 
 class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
@@ -39,11 +40,13 @@ class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
 
     def _transform(self, t: Table) -> Table:
         col = t[self.input_col]
-        idx = np.searchsorted(self._levels, col)
-        idx = np.clip(idx, 0, len(self._levels) - 1)
-        found = self._levels[idx] == col
+        idx, found = lookup_levels(self._levels, col)
         out = np.where(found & ~_is_missing(col), idx, -1).astype(np.int64)
-        return t.with_column(self.output_col, out)
+        # stamp categorical metadata so downstream stages can recover the
+        # level names (core/schema/Categoricals.scala's CategoricalColumnInfo)
+        return (t.with_column(self.output_col, out)
+                 .with_column_meta(self.output_col,
+                                   categorical_levels=self._levels.tolist()))
 
 
 class IndexToValue(Model, HasInputCol, HasOutputCol):

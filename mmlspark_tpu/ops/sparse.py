@@ -12,6 +12,10 @@ from __future__ import annotations
 
 import numpy as np
 
+# policy shared by every featurizer with a dense/sparse auto switch: widths
+# above this emit sparse pair columns under dense_output='auto'
+DENSE_AUTO_LIMIT = 1 << 14
+
 
 def _densify(i, v, width):
     import jax.numpy as jnp

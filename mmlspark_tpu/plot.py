@@ -1,0 +1,76 @@
+"""Plotting helpers (reference: mmlspark/plot/plot.py — confusionMatrix and
+roc over scored frames). Figures are matplotlib, gated behind lazy imports;
+metric math comes from train/metrics so the plots agree with the evaluators.
+Each helper takes a Table (or anything with [col]) and returns the Axes so
+callers can compose/export.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .train import metrics as _metrics
+
+
+def confusion_matrix(t, y_col: str, y_hat_col: str, labels=None, ax=None):
+    """Normalized confusion-matrix heatmap with counts overlaid
+    (reference: plot.confusionMatrix)."""
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    y = np.asarray(t[y_col])
+    y_hat = np.asarray(t[y_hat_col])
+    if labels is None:
+        labels = np.unique(np.concatenate([y, y_hat]))
+    lab_ix = {v: i for i, v in enumerate(labels)}
+    cm = np.zeros((len(labels), len(labels)), np.int64)
+    for yt, yp in zip(y, y_hat):
+        # values outside an explicit label list are excluded from the matrix
+        # (sklearn confusion_matrix(labels=...) semantics); accuracy below
+        # still covers every row
+        if yt in lab_ix and yp in lab_ix:
+            cm[lab_ix[yt], lab_ix[yp]] += 1
+    with np.errstate(invalid="ignore"):
+        cmn = cm / np.maximum(cm.sum(axis=1, keepdims=True), 1)
+    accuracy = float((y == y_hat).mean())
+
+    if ax is None:
+        _, ax = plt.subplots()
+    ax.imshow(cmn, interpolation="nearest", cmap="Blues", vmin=0, vmax=1)
+    ax.set_xticks(range(len(labels)), [str(v) for v in labels])
+    ax.set_yticks(range(len(labels)), [str(v) for v in labels])
+    for i in range(len(labels)):
+        for j in range(len(labels)):
+            ax.text(j, i, str(cm[i, j]), ha="center",
+                    color="white" if cmn[i, j] > 0.5 else "black")
+    ax.set_xlabel("Predicted Label")
+    ax.set_ylabel("True Label")
+    ax.set_title(f"Accuracy = {accuracy * 100:.1f}%")
+    return ax
+
+
+def roc(t, y_col: str, score_col: str, thresh: float = 0.5, ax=None):
+    """ROC curve (reference: plot.roc); AUC from train.metrics so the figure
+    matches ComputeModelStatistics."""
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    y = (np.asarray(t[y_col], np.float64) > thresh).astype(np.float64)
+    s = np.asarray(t[score_col], np.float64)
+    order = np.argsort(-s)
+    ys = y[order]
+    tps = np.cumsum(ys)
+    fps = np.cumsum(1 - ys)
+    tpr = np.concatenate([[0.0], tps / max(ys.sum(), 1)])
+    fpr = np.concatenate([[0.0], fps / max((1 - ys).sum(), 1)])
+    auc = _metrics.auc(y, s)
+
+    if ax is None:
+        _, ax = plt.subplots()
+    ax.plot(fpr, tpr, label=f"AUC = {auc:.3f}")
+    ax.plot([0, 1], [0, 1], linestyle="--", linewidth=0.8)
+    ax.set_xlabel("False Positive Rate")
+    ax.set_ylabel("True Positive Rate")
+    ax.legend()
+    return ax

@@ -184,3 +184,55 @@ def test_table_holds_device_arrays_lazily():
     for c in ("x", "y", "z"):
         assert isinstance(m[c], np.ndarray), c
     np.testing.assert_allclose(m["z"], np.arange(6.0) * 2)
+
+
+def test_column_metadata_propagates():
+    """Categorical metadata survives functional updates (the role of Spark
+    column Metadata, core/schema/Categoricals.scala)."""
+    import numpy as np
+    from mmlspark_tpu import Table
+
+    t = Table({"c": np.array([2, 0, 1]), "x": np.arange(3.0)})
+    t = t.with_column_meta("c", categorical_levels=["lo", "mid", "hi"])
+    assert t.categorical_levels("c") == ["lo", "mid", "hi"]
+    # survives with_column / select / filter / rename / repartition
+    t2 = (t.with_column("y", np.arange(3.0))
+           .select(["c", "y"]).filter(np.array([True, True, False]))
+           .repartition(2))
+    assert t2.categorical_levels("c") == ["lo", "mid", "hi"]
+    t3 = t.rename({"c": "cat"})
+    assert t3.categorical_levels("cat") == ["lo", "mid", "hi"]
+    assert t3.categorical_levels("x") is None
+
+
+def test_value_indexer_stamps_categorical_metadata():
+    import numpy as np
+    from mmlspark_tpu import Table
+    from mmlspark_tpu.featurize.value_indexer import ValueIndexer
+
+    t = Table({"color": np.array(["b", "a", "b"], dtype=object)})
+    m = ValueIndexer(input_col="color", output_col="ix").fit(t)
+    out = m.transform(t)
+    assert out.categorical_levels("ix") == ["a", "b"]
+
+
+def test_column_metadata_lifecycle():
+    """Metadata dies with its column: drop+re-add and replacement must not
+    inherit stale categorical levels; split/concat keep live ones."""
+    import numpy as np
+    from mmlspark_tpu import Table
+
+    t = Table({"c": np.array([0, 1, 2]), "x": np.arange(3.0)})
+    t = t.with_column_meta("c", categorical_levels=["a", "b", "c"])
+    # replacement clears
+    t2 = t.with_column("c", np.arange(3.0))
+    assert t2.categorical_levels("c") is None
+    # drop then re-add clears
+    t3 = t.drop("c").with_column("c", np.array([9, 9, 9]))
+    assert t3.categorical_levels("c") is None
+    # split / concat / partition keep
+    a, b = t.split(0.5, seed=0)
+    assert a.categorical_levels("c") == ["a", "b", "c"]
+    assert b.categorical_levels("c") == ["a", "b", "c"]
+    assert a.concat(b).categorical_levels("c") == ["a", "b", "c"]
+    assert t.repartition(2).partition(0).categorical_levels("c") == ["a", "b", "c"]

@@ -1,0 +1,108 @@
+"""CyberML suites (reference tests: cyber test notebooks/explicit tests —
+anomalous cross-group accesses must outscore in-group accesses)."""
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.cyber import (AccessAnomaly, ComplementAccessTransformer,
+                                IdIndexer, LinearScalarScaler,
+                                StandardScalarScaler)
+from tests.fuzzing import fuzz_estimator, fuzz_transformer
+
+FUZZ_COVERED = ["IdIndexerModel", "LinearScalarScalerModel",
+                "StandardScalarScalerModel", "AccessAnomalyModel"]
+
+
+@pytest.fixture
+def access_log():
+    """Two tenants; within each, users 0-19 hit resources 0-9 and users 20-39
+    hit resources 10-19 (clustered access)."""
+    rng = np.random.default_rng(0)
+    rows_t, rows_u, rows_r = [], [], []
+    for ten in ("contoso", "fabrikam"):
+        for _ in range(1500):
+            if rng.random() < 0.5:
+                u = rng.integers(0, 20)
+                r = rng.integers(0, 10)
+            else:
+                u = rng.integers(20, 40)
+                r = rng.integers(10, 20)
+            rows_t.append(ten)
+            rows_u.append(f"user_{u}")
+            rows_r.append(f"res_{r}")
+    return Table({"tenant": np.asarray(rows_t, dtype=object),
+                  "user": np.asarray(rows_u, dtype=object),
+                  "res": np.asarray(rows_r, dtype=object)})
+
+
+def test_id_indexer_per_tenant(access_log):
+    model, out = fuzz_estimator(
+        IdIndexer(input_col="user", output_col="user_ix"), access_log)
+    assert out["user_ix"].min() >= 1  # 1-based like the reference
+    assert model.vocab_size("contoso") == 40
+    # unseen value -> 0
+    t2 = Table({"tenant": np.asarray(["contoso"], dtype=object),
+                "user": np.asarray(["martian"], dtype=object)})
+    assert model.transform(t2)["user_ix"][0] == 0
+
+
+def test_standard_scaler_per_tenant():
+    t = Table({"tenant": np.asarray(["a"] * 50 + ["b"] * 50, dtype=object),
+               "x": np.concatenate([np.random.default_rng(1).normal(10, 2, 50),
+                                    np.random.default_rng(2).normal(-5, 7, 50)])})
+    model, out = fuzz_estimator(
+        StandardScalarScaler(input_col="x", output_col="z"), t)
+    for ten in ("a", "b"):
+        z = out["z"][np.asarray(t["tenant"]) == ten]
+        assert abs(z.mean()) < 1e-9 and abs(z.std() - 1) < 1e-9
+
+
+def test_linear_scaler_per_tenant():
+    t = Table({"tenant": np.asarray(["a"] * 10, dtype=object),
+               "x": np.arange(10.0)})
+    model, out = fuzz_estimator(
+        LinearScalarScaler(input_col="x", output_col="y",
+                           min_required_value=0.0, max_required_value=1.0), t)
+    np.testing.assert_allclose(out["y"], np.arange(10.0) / 9.0)
+
+
+def test_complement_access():
+    t = Table({"tenant": np.asarray(["a"] * 4, dtype=object),
+               "user_ix": np.asarray([0, 0, 1, 1]),
+               "res_ix": np.asarray([0, 1, 0, 1])})
+    # grid is 2x2 fully observed -> complement is empty
+    out = ComplementAccessTransformer().transform(t)
+    assert len(out) == 0
+    t2 = Table({"tenant": np.asarray(["a"] * 2, dtype=object),
+                "user_ix": np.asarray([0, 3]),
+                "res_ix": np.asarray([0, 3])})
+    out = fuzz_transformer(ComplementAccessTransformer(seed=1), t2)
+    seen = {(0, 0), (3, 3)}
+    for u, r in zip(out["user_ix"], out["res_ix"]):
+        assert (u, r) not in seen
+    assert len(out) == 4  # factor 2 x 2 observed
+
+
+def test_access_anomaly_scores_cross_access_higher(access_log):
+    model, _ = fuzz_estimator(
+        AccessAnomaly(max_iter=10, rank=8), access_log, access_log.take(50),
+        rtol=1e-3)
+    # in-group accesses (normal) vs cross-group (anomalous)
+    normal = Table({"tenant": np.asarray(["contoso"] * 20, dtype=object),
+                    "user": np.asarray([f"user_{u}" for u in range(10)] * 2,
+                                       dtype=object),
+                    "res": np.asarray([f"res_{r}" for r in range(5)] * 4,
+                                      dtype=object)})
+    crossed = Table({"tenant": np.asarray(["contoso"] * 20, dtype=object),
+                     "user": np.asarray([f"user_{u}" for u in range(10)] * 2,
+                                        dtype=object),
+                     "res": np.asarray([f"res_{r}" for r in range(15, 20)] * 4,
+                                       dtype=object)})
+    s_norm = model.transform(normal)["anomaly_score"]
+    s_cross = model.transform(crossed)["anomaly_score"]
+    assert s_cross.mean() > s_norm.mean() + 1.0, (s_norm.mean(), s_cross.mean())
+    # unseen users score 0 (no evidence)
+    unseen = Table({"tenant": np.asarray(["contoso"], dtype=object),
+                    "user": np.asarray(["stranger"], dtype=object),
+                    "res": np.asarray(["res_0"], dtype=object)})
+    assert model.transform(unseen)["anomaly_score"][0] == 0.0

@@ -1,0 +1,84 @@
+"""Plot helpers + PowerBI writer tests (reference: plot/plot.py smoke tests,
+io/split_tests PowerBIWriter against a local endpoint)."""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu import plot as mplot
+from mmlspark_tpu.io import powerbi
+
+
+def test_confusion_matrix_plot():
+    t = Table({"y": np.array([0, 0, 1, 1, 1]),
+               "y_hat": np.array([0, 1, 1, 1, 0])})
+    ax = mplot.confusion_matrix(t, "y", "y_hat")
+    assert "60.0%" in ax.get_title()
+    # image content matches the hand confusion matrix [[1,1],[1,2]]
+    img = ax.get_images()[0].get_array()
+    np.testing.assert_allclose(img, [[0.5, 0.5], [1 / 3, 2 / 3]])
+
+
+def test_roc_plot():
+    rng = np.random.default_rng(0)
+    y = (rng.uniform(size=200) > 0.5).astype(float)
+    s = np.clip(y * 0.7 + rng.normal(scale=0.2, size=200), 0, 1)
+    ax = mplot.roc(Table({"y": y, "score": s}), "y", "score")
+    label = ax.get_legend().get_texts()[0].get_text()
+    from mmlspark_tpu.train import metrics
+    assert f"{metrics.auc(y, s):.3f}" in label
+
+
+class _PBIHandler(BaseHTTPRequestHandler):
+    received = []
+    fail_next = 0
+    lock = threading.Lock()
+
+    def do_POST(self):
+        cls = _PBIHandler
+        n = int(self.headers.get("Content-Length", 0))
+        rows = json.loads(self.rfile.read(n))
+        with cls.lock:
+            if cls.fail_next > 0:
+                cls.fail_next -= 1
+                self.send_response(400)
+                self.end_headers()
+                self.wfile.write(b"bad rows")
+                return
+            cls.received.append(rows)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def pbi_server():
+    _PBIHandler.received = []
+    _PBIHandler.fail_next = 0
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _PBIHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}/push"
+    srv.shutdown()
+
+
+def test_powerbi_write_batches(pbi_server):
+    t = Table({"name": np.array(["a", "b", "c", "d", "e"], dtype=object),
+               "value": np.arange(5.0)})
+    n = powerbi.write(t, pbi_server, batch_size=2)
+    assert n == 3
+    got = [row for batch in _PBIHandler.received for row in batch]
+    assert sorted(r["name"] for r in got) == ["a", "b", "c", "d", "e"]
+    assert all(isinstance(r["value"], float) for r in got)
+
+
+def test_powerbi_write_fails_loud(pbi_server):
+    _PBIHandler.fail_next = 10  # exhaust retries
+    t = Table({"x": np.arange(3.0)})
+    with pytest.raises(powerbi.PowerBIWriteError, match="400"):
+        powerbi.write(t, pbi_server, batch_size=10, retry_times=2)

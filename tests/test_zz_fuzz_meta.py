@@ -23,6 +23,8 @@ TESTS_DIR = pathlib.Path(__file__).parent
 EXEMPT = {
     "Pipeline": "framework plumbing; round-tripped inside every fuzz_* call",
     "PipelineModel": "framework plumbing; round-tripped inside every fuzz_* call",
+    "CognitiveServiceBase": "abstract service base (_build_requests raises); "
+                            "concrete services are fuzzed in test_cognitive",
 }
 
 
