@@ -67,8 +67,18 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
             h["Ocp-Apim-Subscription-Key"] = key
         return h
 
+    def _parse_errors(self, resp_json, row_count: int):
+        """Per-row service-level error messages (None = ok); services with
+        per-document error arrays override (TextAnalytics errors[])."""
+        return [None] * row_count
+
     def _transform(self, t: Table) -> Table:
         reqs = self._build_requests(t)
+        spans = self._request_row_spans(t)
+        if len(reqs) != len(spans):
+            raise RuntimeError(
+                f"{type(self).__name__}: {len(reqs)} requests vs "
+                f"{len(spans)} row spans")
         req_col = t.find_unused_column_name("__cog_req")
         resp_col = t.find_unused_column_name("__cog_resp")
         reqs_arr = np.empty(len(reqs), dtype=object)
@@ -82,7 +92,7 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
             timeout=self.timeout, retry_times=self.retry_times,
             backoff=self.backoff)
         responses = client.transform(rt)[resp_col]
-        outputs, errors = self._route(responses, len(t))
+        outputs, errors = self._route(responses, spans, len(t))
         out_arr = np.empty(len(t), dtype=object)
         out_arr[:] = outputs
         err_arr = np.empty(len(t), dtype=object)
@@ -90,11 +100,10 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
         return t.with_columns({self.output_col: out_arr,
                                self.error_col: err_arr})
 
-    def _route(self, responses, n_rows: int):
+    def _route(self, responses, spans, n_rows: int):
         """Distribute batched responses back onto rows."""
         outputs: list = [None] * n_rows
         errors: list = [None] * n_rows
-        spans = self._request_row_spans(n_rows)
         for resp, (lo, hi) in zip(responses, spans):
             if resp is None or resp.status != 200:
                 msg = (f"HTTP {resp.status}: {resp.error or resp.reason}"
@@ -103,15 +112,18 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
                     errors[i] = msg
                 continue
             try:
-                vals = self._parse_response(resp.json(), hi - lo)
+                payload = resp.json()
             except ValueError as e:
                 for i in range(lo, hi):
                     errors[i] = f"bad JSON: {e}"
                 continue
-            for i, v in zip(range(lo, hi), vals):
+            vals = self._parse_response(payload, hi - lo)
+            errs = self._parse_errors(payload, hi - lo)
+            for i, v, e in zip(range(lo, hi), vals, errs):
                 outputs[i] = v
+                errors[i] = e
         return outputs, errors
 
-    def _request_row_spans(self, n_rows: int):
+    def _request_row_spans(self, t: Table):
         """Row range each request covers; default 1:1."""
-        return [(i, i + 1) for i in range(n_rows)]
+        return [(i, i + 1) for i in range(len(t))]

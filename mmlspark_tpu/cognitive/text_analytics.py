@@ -24,16 +24,26 @@ class _TextAnalyticsBase(CognitiveServiceBase, HasInputCol):
     # subclasses: path + the field extracted from each response document
     _doc_field = "score"
 
-    def _request_row_spans(self, n_rows: int):
-        b = self.batch_size
-        return [(lo, min(lo + b, n_rows)) for lo in range(0, n_rows, b)]
+    def _request_row_spans(self, t: Table):
+        """Batch boundaries: every batch_size rows AND wherever the per-row
+        subscription key changes — a request authenticates with ONE key, so
+        rows with different keys may never share a batch."""
+        n_rows = len(t)
+        keys = self._service_value(t, "subscription_key")
+        spans = []
+        lo = 0
+        for i in range(1, n_rows + 1):
+            if i == n_rows or i - lo >= self.batch_size or keys[i] != keys[lo]:
+                spans.append((lo, i))
+                lo = i
+        return spans
 
     def _build_requests(self, t: Table):
         texts = t[self.input_col]
         langs = self._service_value(t, "language")
         keys = self._service_value(t, "subscription_key")
         reqs = []
-        for lo, hi in self._request_row_spans(len(t)):
+        for lo, hi in self._request_row_spans(t):
             docs = [{"id": str(i - lo), "language": str(langs[i]),
                      "text": str(texts[i])} for i in range(lo, hi)]
             reqs.append(HTTPRequest(
@@ -44,16 +54,16 @@ class _TextAnalyticsBase(CognitiveServiceBase, HasInputCol):
 
     def _parse_response(self, payload, row_count: int):
         by_id = {str(d.get("id")): d for d in payload.get("documents", [])}
+        return [self._extract(by_id[str(i)]) if str(i) in by_id else None
+                for i in range(row_count)]
+
+    def _parse_errors(self, payload, row_count: int):
         err_by_id = {str(e.get("id")): e for e in payload.get("errors", [])}
         out = []
         for i in range(row_count):
-            doc = by_id.get(str(i))
-            if doc is not None:
-                out.append(self._extract(doc))
-            elif str(i) in err_by_id:
-                out.append(None)
-            else:
-                out.append(None)
+            e = err_by_id.get(str(i))
+            out.append(None if e is None else
+                       str(e.get("message", e.get("error", e))))
         return out
 
     def _extract(self, doc: dict):

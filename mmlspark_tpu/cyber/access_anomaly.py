@@ -45,15 +45,19 @@ class ComplementAccessTransformer(Transformer):
         for ten in np.unique(tenants):
             m = tenants == ten
             seen = set(zip(users[m].tolist(), res[m].tolist()))
-            n_users = users[m].max() + 1
-            n_res = res[m].max() + 1
+            # ids are 1-based (IdIndexer reserves 0 for unseen) — never
+            # fabricate complement tuples with the sentinel id
+            u_lo = 1 if users[m].min() >= 1 else 0
+            r_lo = 1 if res[m].min() >= 1 else 0
+            n_users = int(users[m].max()) + 1
+            n_res = int(res[m].max()) + 1
             want = self.complementset_factor * int(m.sum())
-            cap = n_users * n_res - len(seen)
+            cap = (n_users - u_lo) * (n_res - r_lo) - len(seen)
             want = min(want, max(cap, 0))
             got = 0
             while got < want:
-                cu = rng.integers(0, n_users, size=want * 2)
-                cr = rng.integers(0, n_res, size=want * 2)
+                cu = rng.integers(u_lo, n_users, size=want * 2)
+                cr = rng.integers(r_lo, n_res, size=want * 2)
                 for u, r in zip(cu.tolist(), cr.tolist()):
                     if (u, r) not in seen:
                         seen.add((u, r))
@@ -145,11 +149,10 @@ class AccessAnomaly(Estimator):
             np.add.at(mat, (u_ix, r_ix), counts[m])
             obs = mat > 0
             if not obs.any():
-                # a tenant whose likelihood column is all zero has no
-                # positive evidence; every cell trains at neg_score
-                obs = np.ones_like(mat, bool) * False
-                scaled = np.full_like(mat, self.neg_score)
-            elif mat[obs].max() > mat[obs].min():
+                # no positive evidence for this tenant: nothing to factorize;
+                # transform scores its rows 0 ("no evidence"), same as unseen
+                continue
+            if mat[obs].max() > mat[obs].min():
                 lo, hi = mat[obs].min(), mat[obs].max()
                 scaled = (self.low_value
                           + (mat - lo) * (self.high_value - self.low_value)
@@ -157,8 +160,6 @@ class AccessAnomaly(Estimator):
             else:
                 scaled = np.full_like(mat, self.high_value)
             ratings = np.where(obs, scaled, self.neg_score)
-            if not obs.any():
-                ratings = scaled
             # weights: observed 1; unobserved cells get the complement-set
             # weight factor/|cells| so negatives softly pull scores down
             # (the reference materializes factor x N sampled complement rows;

@@ -1,0 +1,84 @@
+"""Data sources: CSV, binary files, images -> Table.
+
+Role-equivalent to the reference's data sources (SURVEY.md §2.6:
+io/binary/BinaryFileFormat.scala, io/image/ImageFileFormat.scala, plus the
+CSV ingestion its examples lean on). Numeric CSV parsing routes through the
+native C++ kernel (native/kernels.cpp parse_csv_floats) when available.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+
+import numpy as np
+
+from ..core import Table
+
+
+def read_csv(path: str, label_col: str = None, npartitions: int = 1) -> Table:
+    """Header-aware CSV -> Table. Numeric columns parse natively (C++) when
+    the toolchain is available; non-numeric columns fall back to numpy."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    header, _, _ = raw.partition(b"\n")
+    names = [h.strip().decode() for h in header.split(b",")]
+    cols = len(names)
+
+    from ..native import parse_csv_native
+    mat = parse_csv_native(raw, cols, skip_rows=1)
+    if mat is None:  # no compiler: numpy fallback
+        mat = np.genfromtxt(path, delimiter=",", skip_header=1,
+                            dtype=np.float32, invalid_raise=False)
+        mat = mat.reshape(-1, cols)
+
+    data = {}
+    text_cols = [j for j in range(cols) if np.isnan(mat[:, j]).all()]
+    if text_cols:  # re-read only the non-numeric columns as strings
+        str_mat = np.genfromtxt(path, delimiter=",", skip_header=1,
+                                dtype=str, usecols=text_cols)
+        str_mat = str_mat.reshape(mat.shape[0], len(text_cols))
+    for j, name in enumerate(names):
+        if j in text_cols:
+            data[name] = str_mat[:, text_cols.index(j)].astype(object)
+        else:
+            data[name] = mat[:, j]
+    return Table(data, npartitions)
+
+
+def read_binary_files(pattern: str, npartitions: int = 1) -> Table:
+    """Glob files into a Table of (path, bytes) — the reference's
+    BinaryFileFormat (io/binary/BinaryFileFormat.scala) reader shape."""
+    paths = sorted(_glob.glob(pattern, recursive=True))
+    blobs = np.empty(len(paths), dtype=object)
+    for i, p in enumerate(paths):
+        with open(p, "rb") as f:
+            blobs[i] = f.read()
+    return Table({"path": np.asarray(paths, dtype=object), "bytes": blobs},
+                 npartitions)
+
+
+def read_images(pattern: str, size: tuple = None,
+                npartitions: int = 1) -> Table:
+    """Glob image files into (path, image) with images decoded to
+    (H, W, C) float32 arrays — the reference's ImageFileFormat
+    (io/image/ImageFileFormat.scala). `size=(H, W)` resizes on load, making
+    the image column a single stackable (N, H, W, C) array; without it the
+    column is per-row object arrays."""
+    from PIL import Image
+
+    paths = sorted(_glob.glob(pattern, recursive=True))
+    imgs = []
+    for p in paths:
+        with Image.open(p) as im:
+            im = im.convert("RGB")
+            if size is not None:
+                im = im.resize((size[1], size[0]))
+            imgs.append(np.asarray(im, np.float32))
+    if size is not None and imgs:
+        image_col = np.stack(imgs)
+    else:
+        image_col = np.empty(len(imgs), dtype=object)
+        for i, im in enumerate(imgs):
+            image_col[i] = im
+    return Table({"path": np.asarray(paths, dtype=object),
+                  "image": image_col}, npartitions)

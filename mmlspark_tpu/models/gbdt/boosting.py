@@ -64,6 +64,9 @@ class BoostParams:
     # (reference: FObjTrait.getGradient, lightgbm/params/FObjTrait.scala:17);
     # forces the host boosting loop so arbitrary numpy/jax callables work
     fobj: Optional[Callable] = None
+    # rf continuation: total ensemble size for 1/T averaging weights when a
+    # resumed fit trains only the remaining trees (0 = num_iterations)
+    rf_total: int = 0
     # control
     seed: int = 0
     early_stopping_round: int = 0
@@ -319,7 +322,9 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 callbacks: Optional[Callbacks] = None,
                 tree_fn=None, put_fn=None, chunk_fn=None,
                 prebinned: Optional[tuple] = None,
-                presence: Optional[np.ndarray] = None):
+                presence: Optional[np.ndarray] = None,
+                checkpoint_fn=None, checkpoint_interval: int = 25,
+                init_base: float = 0.0):
     """Train a Booster on host arrays. Single-device by default; the
     distributed path (distributed.py) passes a shard_map-wrapped `tree_fn`
     and a sharding `put_fn`, and this same loop runs over the mesh.
@@ -356,10 +361,19 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
              if group is not None else None)
 
     base = 0.0
-    if p.boost_from_average and init_scores is None and not multiclass:
+    if init_booster is not None:
+        # continuation: new trees fit the residuals of the existing ensemble;
+        # its base (init_base) carries over instead of recomputing the mean
+        base = float(init_base)
+    elif p.boost_from_average and init_scores is None and not multiclass:
         base = obj_mod.init_score(p.objective, y, weights=weights)
+    init_margin_arr = None
+    if init_booster is not None:
+        init_margin_arr = init_booster.raw_score(x)  # (n, K)
     if multiclass:
         margin = put(np.zeros((n, p.num_class), dtype=np.float32))
+        if init_margin_arr is not None:
+            margin = margin + put(init_margin_arr.astype(np.float32))
         y_onehot = jax.nn.one_hot(y_j.astype(jnp.int32), p.num_class,
                                   dtype=jnp.float32)
         if init_scores is not None:
@@ -371,6 +385,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             margin = margin + put(init_arr)
     else:
         margin = put(np.full((n,), base, dtype=np.float32))
+        if init_margin_arr is not None:
+            margin = margin + put(init_margin_arr[:, 0].astype(np.float32))
         if init_scores is not None:
             margin = margin + put(np.asarray(init_scores, dtype=np.float32))
 
@@ -383,6 +399,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             v_margin = jnp.zeros((vx.shape[0], p.num_class), jnp.float32)
         else:
             v_margin = jnp.full((vx.shape[0],), base, jnp.float32)
+        if init_booster is not None:
+            v_init = init_booster.raw_score(np.asarray(vx, np.float32))
+            v_margin = v_margin + jnp.asarray(
+                v_init if multiclass else v_init[:, 0], jnp.float32)
 
     cfg_base = dict(n_features=n_features, n_bins=p.max_bin + 1,
                     max_depth=p.max_depth, num_leaves=p.num_leaves,
@@ -408,7 +428,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         eval_history = []
         fused = chunk_fn or _boost_chunk
         cfg = trainer.TreeConfig(
-            learning_rate=(1.0 / p.num_iterations if rf else p.learning_rate),
+            learning_rate=(1.0 / (p.rf_total or p.num_iterations) if rf
+                           else p.learning_rate),
             **cfg_base)
         if has_valid:
             vy_j = jnp.asarray(np.asarray(vy, np.float32))
@@ -425,6 +446,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         track = has_valid and (patience > 0 or p.metric is not None)
         chunk = (max(patience, 16) if (track and patience > 0)
                  else p.num_iterations)
+        if checkpoint_fn is not None:
+            # checkpoints happen at chunk boundaries; bound the chunk so a
+            # crash loses at most checkpoint_interval iterations
+            chunk = min(chunk, max(int(checkpoint_interval), 1))
         parts, stop_at = [], None
         best_metric, best_iter, rounds_since = None, -1, 0
         it = 0
@@ -436,6 +461,17 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_, vy_j,
                 v_margin_, kc, it, p, cfg, clen, k_out, has_valid=has_valid)
             parts.append((sf_c, sb_c, lv_c, gn_c, cv_c))
+            if checkpoint_fn is not None:
+                # chunk boundary = natural checkpoint step: build the
+                # booster-so-far from the accumulated parts (host-cheap)
+                _sf, _sb, _lv, _gn, _cv = (
+                    np.concatenate([np.asarray(part[i]) for part in parts])
+                    for i in range(5))
+                _tc = np.tile(np.arange(k_out, dtype=np.int32),
+                              _sf.shape[0] // max(k_out, 1))
+                checkpoint_fn(it + clen, _build_booster(
+                    _sf, _sb, _lv, _tc, mapper, p, k_out, n_features, -1,
+                    init_booster, base, gain=_gn, cover=_cv), base)
             if track:
                 for i, mv in enumerate(np.asarray(mts)):
                     mv = float(mv)
@@ -481,7 +517,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             cb.before_iteration(it)
         lr = cb.get_learning_rate(it) if cb.get_learning_rate else p.learning_rate
         if rf:
-            lr = 1.0 / p.num_iterations  # averaging via scaled sum
+            lr = 1.0 / (p.rf_total or p.num_iterations)  # averaging via scaled sum
         key, k_feat, k_bag, k_drop = jax.random.split(key, 4)
 
         # DART: drop a subset of prior trees from the margin for this iteration
@@ -605,6 +641,20 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 break
         if cb.after_iteration:
             cb.after_iteration(it, metric_val if metric_val is not None else float("nan"))
+        if checkpoint_fn is not None and (it + 1) % max(int(checkpoint_interval), 1) == 0:
+            _max_nodes = 2 ** (p.max_depth + 1) - 1
+            _sf = np.stack([tr.split_feature for tr in trees])
+            _sb = np.stack([tr.split_bin for tr in trees])
+            _lv = np.stack([tr.leaf_value for tr in trees])
+            _gn = np.stack([tr.gain for tr in trees])
+            _cv = np.stack([tr.cover for tr in trees])
+            if dart:
+                _w = np.repeat(np.asarray(dart_weights, np.float32), k_out)
+                _lv = _lv * _w[:, None]
+            checkpoint_fn(it + 1, _build_booster(
+                _sf, _sb, _lv, np.asarray(tree_classes, np.int32), mapper, p,
+                k_out, n_features, -1, init_booster, base, gain=_gn,
+                cover=_cv), base)
 
     max_nodes = 2 ** (p.max_depth + 1) - 1
     T = len(trees)

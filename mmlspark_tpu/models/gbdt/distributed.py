@@ -100,7 +100,9 @@ def _compiled_chunk_fn(mesh, p, cfg, chunk_len: int, k_out: int,
 def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
                             group=None, valid=None, init_booster=None,
                             callbacks=None, parallelism: str = "data_parallel",
-                            top_k: int = 20, num_tasks: int = 0):
+                            top_k: int = 20, num_tasks: int = 0,
+                            checkpoint_fn=None, checkpoint_interval: int = 25,
+                            init_base: float = 0.0):
     """Same training loop as fit_booster, with rows sharded over the mesh.
 
     Split decisions are computed identically on every shard from the psum'd
@@ -154,5 +156,6 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
         x_p, y_p, params, weights=w_p, init_scores=init_p, group=group_p,
         valid=valid, init_booster=init_booster, callbacks=callbacks,
         tree_fn=tree_fn, put_fn=put_rows, chunk_fn=chunk_fn,
-        presence=pres_p)
+        presence=pres_p, checkpoint_fn=checkpoint_fn,
+        checkpoint_interval=checkpoint_interval, init_base=init_base)
     return booster, base, hist

@@ -11,6 +11,8 @@ predict/leaf-index/SHAP output columns like LightGBMModelMethods
 """
 from __future__ import annotations
 
+import dataclasses
+
 from typing import Optional
 
 import numpy as np
@@ -77,6 +79,14 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     fobj = Param("fobj", "custom objective: (margin, y) -> (grad, hess) "
                  "(reference: FObjTrait.scala:17)", None, transient=True)
 
+    checkpoint_dir = Param(
+        "checkpoint_dir",
+        "step-checkpoint directory (utils.checkpoint.CheckpointManager); "
+        "fit() resumes from the latest step and saves every "
+        "checkpoint_interval iterations", None)
+    checkpoint_interval = Param("checkpoint_interval",
+                                "iterations between checkpoints", 25)
+
     def _boost_params(self, objective: str, num_class: int = 1) -> BoostParams:
         return BoostParams(
             # objective extras live on subclasses (GBDTRegressor.alpha /
@@ -134,6 +144,30 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         x, y, w, init = self._fit_data(train)
         params = self._boost_params(objective, num_class)
         n_batches = self.num_batches or 0
+
+        # step-level checkpoint/resume (SURVEY.md §5); single-batch fits only
+        ck_fn, resume_booster, done, resume_base = None, None, 0, 0.0
+        if self.checkpoint_dir and n_batches <= 1:
+            from ...utils.checkpoint import CheckpointManager
+            from .booster import Booster as _B
+            mgr = CheckpointManager(self.checkpoint_dir)
+            latest = mgr.latest_step()
+            if latest is not None:
+                payload = mgr.restore(latest)
+                resume_booster = _B.load_model_string(str(payload["booster"]))
+                done = int(payload["iteration"])
+                resume_base = float(payload.get("base", 0.0))
+            remaining = max(params.num_iterations - done, 0)
+            # rf averaging weights must stay 1/TOTAL across the resume split
+            params = dataclasses.replace(params, num_iterations=remaining,
+                                         rf_total=params.num_iterations)
+
+            def ck_fn(it, booster, fit_base, _mgr=mgr, _done=done):
+                _mgr.save(_done + it,
+                          {"booster": booster.save_model_string(),
+                           "iteration": _done + it, "base": float(fit_base)})
+            if remaining == 0:
+                return resume_booster, resume_base, []
         if self.parallelism and self._use_mesh():
             from .distributed import fit_booster_distributed
             fit = lambda **kw: fit_booster_distributed(
@@ -153,10 +187,14 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                     weights=None if w is None else w[bi],
                     init_scores=None if init is None else init[bi],
                     group=None if group is None else group[bi],
-                    valid=valid, init_booster=booster, callbacks=callbacks)
+                    valid=valid, init_booster=booster, callbacks=callbacks,
+                    init_base=base)
             return booster, base, hist
         return fit(x=x, y=y, params=params, weights=w, init_scores=init,
-                   group=group, valid=valid, callbacks=callbacks)
+                   group=group, valid=valid, callbacks=callbacks,
+                   init_booster=resume_booster, checkpoint_fn=ck_fn,
+                   checkpoint_interval=self.checkpoint_interval,
+                   init_base=resume_base)
 
     def _use_mesh(self) -> bool:
         import jax

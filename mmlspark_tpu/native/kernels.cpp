@@ -1,0 +1,116 @@
+// Host-side native kernels for the TPU framework's data path.
+//
+// Role-equivalent to the reference's native host layer (LightGBM's C++
+// dataset/bin-mapper construction driven over JNI, lightgbm/TrainUtils.scala;
+// SURVEY.md §2.9 item 6): the work that must happen BEFORE device transfer —
+// string hashing, text->float parsing, bin assignment — done at C++ speed
+// with zero-copy numpy buffers over ctypes.
+//
+// Build: g++ -O3 -shared -fPIC kernels.cpp -o _native.so  (native/build.py)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------- murmur3
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const uint32_t c1 = 0xCC9E2D51u, c2 = 0x1B873593u;
+  uint32_t h = seed;
+  const int64_t nblocks = len / 4;
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + 4 * i, 4);  // little-endian hosts only (x86/ARM)
+    k *= c1; k = rotl32(k, 15); k *= c2;
+    h ^= k; h = rotl32(h, 13); h = h * 5 + 0xE6546B64u;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k = 0;
+  switch (len & 3) {
+    case 3: k ^= tail[2] << 16; [[fallthrough]];
+    case 2: k ^= tail[1] << 8;  [[fallthrough]];
+    case 1: k ^= tail[0];
+            k *= c1; k = rotl32(k, 15); k *= c2; h ^= k;
+  }
+  h ^= (uint32_t)len;
+  h ^= h >> 16; h *= 0x85EBCA6Bu; h ^= h >> 13; h *= 0xC2B2AE35u; h ^= h >> 16;
+  return h;
+}
+
+// Packed strings: concatenated UTF-8 bytes + (n+1) offsets.
+// out[i] = murmur3(bytes[offsets[i]:offsets[i+1]], seed) & mask
+void murmur3_batch(const uint8_t* bytes, const int64_t* offsets, int64_t n,
+                   uint32_t seed, int64_t mask, int64_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t h = murmur3_32(bytes + offsets[i], offsets[i + 1] - offsets[i],
+                            seed);
+    out[i] = mask > 0 ? (int64_t)(h & (uint32_t)mask) : (int64_t)h;
+  }
+}
+
+// ---------------------------------------------------------------- binning
+// searchsorted(bounds[f,:n_bounds], v, side='left') per (row, feature) —
+// bit-matching ops/binning.apply_bins (value <= ub[b] lands in bin b;
+// NaN -> n_bins-1, the missing bin).
+void apply_bins(const float* x, int64_t n, int64_t f,
+                const float* bounds, int64_t n_bounds, int64_t n_bins,
+                uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    for (int64_t j = 0; j < f; j++) {
+      float v = x[i * f + j];
+      if (v != v) {  // NaN
+        out[i * f + j] = (uint8_t)(n_bins - 1);
+        continue;
+      }
+      const float* b = bounds + j * n_bounds;
+      int64_t lo = 0, hi = n_bounds;
+      while (lo < hi) {  // lower_bound: first index with b[idx] >= v
+        int64_t mid = (lo + hi) >> 1;
+        if (b[mid] < v) lo = mid + 1; else hi = mid;
+      }
+      out[i * f + j] = (uint8_t)(lo < n_bins ? lo : n_bins - 1);
+    }
+  }
+}
+
+// ---------------------------------------------------------------- CSV
+// Minimal fast CSV float parser: comma/whitespace separated, one row per
+// line, `cols` columns. Unparseable fields become NaN. Returns rows parsed.
+int64_t parse_csv_floats(const char* buf, int64_t len, int64_t cols,
+                         int64_t skip_rows, float* out, int64_t max_rows) {
+  const char* p = buf;
+  const char* end = buf + len;
+  // skip header rows
+  for (int64_t s = 0; s < skip_rows && p < end; s++) {
+    while (p < end && *p != '\n') p++;
+    if (p < end) p++;
+  }
+  int64_t row = 0;
+  while (p < end && row < max_rows) {
+    // skip empty lines
+    if (*p == '\n') { p++; continue; }
+    for (int64_t c = 0; c < cols; c++) {
+      char* next = nullptr;
+      float v = strtof(p, &next);
+      if (next == p) {  // unparseable (e.g. text) -> NaN, skip field
+        v = __builtin_nanf("");
+        while (p < end && *p != ',' && *p != '\n') p++;
+      } else {
+        p = next;
+      }
+      out[row * cols + c] = v;
+      if (p < end && *p == ',') p++;
+    }
+    while (p < end && *p != '\n') p++;  // discard extra fields
+    if (p < end) p++;
+    row++;
+  }
+  return row;
+}
+
+}  // extern "C"

@@ -57,7 +57,15 @@ def hash_token(token: str, seed: int = 0) -> int:
 
 
 def hash_strings(values, seed: int = 0, num_bits: int = 18) -> np.ndarray:
-    """Vectorized (memoized) hash of a string column into [0, 2^num_bits)."""
+    """Vectorized hash of a string column into [0, 2^num_bits). Large batches
+    route to the native C++ kernel (native/kernels.cpp murmur3_batch — same
+    bit-exact algorithm) when the toolchain built it; otherwise the memoized
+    Python path runs."""
+    if len(values) >= 1024:
+        from ..native import hash_strings_native
+        out = hash_strings_native(values, seed=seed, num_bits=num_bits)
+        if out is not None:
+            return out
     mask = (1 << num_bits) - 1
     return np.fromiter((hash_token(str(v), seed) & mask for v in values),
                        dtype=np.int64, count=len(values))

@@ -1,0 +1,91 @@
+"""Step-level checkpointing: atomic, retained, resumable.
+
+Role-equivalent to orbax-style training checkpoints (SURVEY.md §5 flags
+step-level checkpoint/resume as a must-add; the reference leans on model
+strings + batch continuation, LightGBMBase.scala batches). Layout:
+
+    <dir>/step_<k>/payload.npz + meta.json     (atomic via tmp + os.replace)
+
+save() keeps the newest `max_to_keep` steps; restore() loads the latest (or
+a named step). Payloads are dicts of numpy arrays + JSON-able scalars, so
+any model that can serialize to arrays/strings can checkpoint through this.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- introspection ------------------------------------------------------
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                try:
+                    steps.append(int(name[5:]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    # -- save/restore -------------------------------------------------------
+    def save(self, step: int, payload: dict) -> None:
+        """Write arrays to npz + scalars/strings to JSON, atomically: the
+        step directory appears only when complete (tmp dir + os.replace),
+        so a killed process never leaves a half checkpoint."""
+        arrays, meta = {}, {}
+        for k, v in payload.items():
+            if isinstance(v, np.ndarray):
+                arrays[k] = v
+            else:
+                json.dumps(v)  # raise early on unserializable values
+                meta[k] = v
+        tmp = tempfile.mkdtemp(dir=self.directory, prefix=".tmp_")
+        try:
+            if arrays:
+                np.savez(os.path.join(tmp, "payload.npz"), **arrays)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            final = self._step_dir(step)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        # retention
+        steps = self.all_steps()
+        for old in steps[: max(len(steps) - self.max_to_keep, 0)]:
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)
+
+    def restore(self, step: int = None) -> dict:
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory!r}")
+        d = self._step_dir(step)
+        out: dict = {}
+        npz = os.path.join(d, "payload.npz")
+        if os.path.exists(npz):
+            with np.load(npz, allow_pickle=False) as z:
+                out.update({k: z[k] for k in z.files})
+        with open(os.path.join(d, "meta.json")) as f:
+            out.update(json.load(f))
+        return out

@@ -1,0 +1,51 @@
+"""Device tracing/profiling hooks (SURVEY.md §5: tracing/profiling aux
+subsystem; pairs with the Timer stage for wall-clock and utils.stopwatch for
+code blocks).
+
+`trace(dir)` wraps jax.profiler.trace — the resulting trace opens in
+TensorBoard/Perfetto and shows per-op device time, the ground truth for the
+fusion/HBM questions this framework's perf work keeps asking. annotate()
+marks named regions inside a trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a device trace for the enclosed block:
+
+        with tracing.trace("/tmp/trace"):
+            model.fit(table)
+    """
+    import jax
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (jax.profiler.TraceAnnotation)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def wall_clock(label: str, sink=None):
+    """Host-side wall-clock for a block; `sink(label, seconds)` or print."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if sink is not None:
+            sink(label, dt)
+        else:
+            print(f"{label}: {dt:.4f}s")

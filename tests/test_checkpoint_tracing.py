@@ -1,0 +1,104 @@
+"""Checkpoint/resume + tracing tests (SURVEY.md §5 aux subsystems)."""
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import Table
+from mmlspark_tpu.models.gbdt import GBDTRegressor
+from mmlspark_tpu.utils.checkpoint import CheckpointManager
+from mmlspark_tpu.utils import tracing
+
+
+def test_manager_atomic_save_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+    for step in (5, 10, 15):
+        mgr.save(step, {"w": np.arange(step, dtype=np.float32),
+                        "iteration": step, "note": "hello"})
+    # retention: only the last 2 steps survive
+    assert mgr.all_steps() == [10, 15]
+    out = mgr.restore()
+    assert out["iteration"] == 15 and out["note"] == "hello"
+    np.testing.assert_allclose(out["w"], np.arange(15))
+    out10 = mgr.restore(10)
+    assert out10["iteration"] == 10
+    # a stale tmp dir from a killed process is invisible to restore
+    os.makedirs(tmp_path / "ck" / ".tmp_dead", exist_ok=True)
+    assert mgr.latest_step() == 15
+
+
+@pytest.fixture
+def reg_data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 5)).astype(np.float32)
+    y = (x @ [1, -2, 0.5, 0, 3] + 0.05 * rng.normal(size=400)).astype(np.float32)
+    return Table({"features": x, "label": y})
+
+
+def test_gbdt_checkpoints_and_resumes(reg_data, tmp_path):
+    ck = str(tmp_path / "gbdt_ck")
+    full = GBDTRegressor(num_iterations=30, seed=3).fit(reg_data)
+
+    # interrupted run: only 10 iterations, checkpointing every 5
+    GBDTRegressor(num_iterations=10, seed=3, checkpoint_dir=ck,
+                  checkpoint_interval=5).fit(reg_data)
+    mgr = CheckpointManager(ck)
+    assert mgr.latest_step() == 10
+
+    # resumed run: SAME 30-iteration config continues from step 10
+    resumed = GBDTRegressor(num_iterations=30, seed=3, checkpoint_dir=ck,
+                            checkpoint_interval=5).fit(reg_data)
+    assert resumed.booster.n_trees == 30
+    assert mgr.latest_step() == 30
+    # quality comparable to the uninterrupted run
+    pred_full = full.transform(reg_data)["prediction"]
+    pred_res = resumed.transform(reg_data)["prediction"]
+    y = np.asarray(reg_data["label"])
+    mse_full = float(np.mean((pred_full - y) ** 2))
+    mse_res = float(np.mean((pred_res - y) ** 2))
+    assert mse_res < mse_full * 1.5 + 1e-3
+
+    # fully-trained checkpoint: fit() returns it without training
+    again = GBDTRegressor(num_iterations=30, seed=3,
+                          checkpoint_dir=ck).fit(reg_data)
+    assert again.booster.n_trees == 30
+
+
+def test_tracing_produces_trace(tmp_path):
+    import jax.numpy as jnp
+    d = str(tmp_path / "trace")
+    with tracing.trace(d):
+        with tracing.annotate("matmul"):
+            float(jnp.ones((64, 64)).sum())
+    found = []
+    for root, _, files in os.walk(d):
+        found += [f for f in files if f.endswith((".pb", ".json.gz", ".xplane.pb"))]
+    assert found, "no trace artifacts written"
+
+
+def test_wall_clock_sink():
+    seen = {}
+    with tracing.wall_clock("block", sink=lambda k, v: seen.update({k: v})):
+        pass
+    assert "block" in seen and seen["block"] >= 0
+
+
+def test_rf_resume_keeps_total_averaging_weight(reg_data, tmp_path):
+    """Random-forest trees average with weight 1/TOTAL; a resumed fit must
+    not reweight its trees by 1/remaining."""
+    ck = str(tmp_path / "rf_ck")
+    from mmlspark_tpu.models.gbdt import GBDTRegressor
+    GBDTRegressor(num_iterations=8, boosting="rf", bagging_fraction=0.8,
+                  seed=5, checkpoint_dir=ck, checkpoint_interval=4).fit(reg_data)
+    resumed = GBDTRegressor(num_iterations=16, boosting="rf",
+                            bagging_fraction=0.8, seed=5, checkpoint_dir=ck,
+                            checkpoint_interval=4).fit(reg_data)
+    full = GBDTRegressor(num_iterations=16, boosting="rf",
+                         bagging_fraction=0.8, seed=5).fit(reg_data)
+    # leaf magnitudes of the resumed second half match the full run's scale
+    lv_res = np.abs(resumed.booster.leaf_value[8:]).max()
+    lv_full = np.abs(full.booster.leaf_value[8:]).max()
+    assert lv_res < lv_full * 1.6 + 1e-6, (lv_res, lv_full)

@@ -220,3 +220,27 @@ def test_bing_image_search_and_url_explode(server):
     urls = BingImageSearch.get_urls(out, "results")
     assert len(urls) == 6
     assert urls["imageUrl"][0].startswith("http://img/cats/")
+
+
+def test_batches_split_on_key_change(server):
+    """A request authenticates with one key, so per-row keys force batch
+    boundaries — the second row's good key must not ride the first's."""
+    t = Table({"text": np.array(["good a", "good b", "good c"], dtype=object),
+               "keys": np.array(["wrong", GOOD_KEY, GOOD_KEY], dtype=object)})
+    ts = TextSentiment(url=f"{server}/text/analytics/v2.0/sentiment",
+                       subscription_key_col="keys", input_col="text",
+                       output_col="s", batch_size=25, retry_times=1)
+    out = ts.transform(t)
+    assert out["s"][0] is None and "401" in out["errors"][0]
+    assert out["s"][1] == 0.9 and out["s"][2] == 0.9  # separate request
+
+
+def test_per_document_errors_reach_error_col(server):
+    t = Table({"text": np.array(["good", "", "good"], dtype=object)})
+    ts = TextSentiment(url=f"{server}/text/analytics/v2.0/sentiment",
+                       subscription_key=GOOD_KEY, input_col="text",
+                       output_col="s", batch_size=3)
+    out = ts.transform(t)
+    assert out["s"][1] is None
+    assert "empty document" in out["errors"][1]
+    assert out["errors"][0] is None and out["errors"][2] is None
