@@ -8,16 +8,16 @@ native C++ kernel (native/kernels.cpp parse_csv_floats) when available.
 from __future__ import annotations
 
 import glob as _glob
-import os
 
 import numpy as np
 
 from ..core import Table
 
 
-def read_csv(path: str, label_col: str = None, npartitions: int = 1) -> Table:
+def read_csv(path: str, npartitions: int = 1) -> Table:
     """Header-aware CSV -> Table. Numeric columns parse natively (C++) when
-    the toolchain is available; non-numeric columns fall back to numpy."""
+    the toolchain is available; non-numeric columns (including prefix-numeric
+    strings like dates, which the native parser flags) re-read as text."""
     with open(path, "rb") as f:
         raw = f.read()
     header, _, _ = raw.partition(b"\n")
@@ -25,14 +25,18 @@ def read_csv(path: str, label_col: str = None, npartitions: int = 1) -> Table:
     cols = len(names)
 
     from ..native import parse_csv_native
-    mat = parse_csv_native(raw, cols, skip_rows=1)
-    if mat is None:  # no compiler: numpy fallback
+    parsed = parse_csv_native(raw, cols, skip_rows=1, return_clean=True)
+    if parsed is None:  # no compiler: numpy fallback
         mat = np.genfromtxt(path, delimiter=",", skip_header=1,
                             dtype=np.float32, invalid_raise=False)
         mat = mat.reshape(-1, cols)
+        clean = ~np.isnan(mat).all(axis=0)
+    else:
+        mat, clean = parsed
 
     data = {}
-    text_cols = [j for j in range(cols) if np.isnan(mat[:, j]).all()]
+    text_cols = [j for j in range(cols)
+                 if not clean[j] or np.isnan(mat[:, j]).all()]
     if text_cols:  # re-read only the non-numeric columns as strings
         str_mat = np.genfromtxt(path, delimiter=",", skip_header=1,
                                 dtype=str, usecols=text_cols)

@@ -370,8 +370,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     init_margin_arr = None
     if init_booster is not None:
         init_margin_arr = init_booster.raw_score(x)  # (n, K)
+    margin_no_continuation = None  # rf: gradients target y, not residuals
     if multiclass:
         margin = put(np.zeros((n, p.num_class), dtype=np.float32))
+        margin_no_continuation = margin
         if init_margin_arr is not None:
             margin = margin + put(init_margin_arr.astype(np.float32))
         y_onehot = jax.nn.one_hot(y_j.astype(jnp.int32), p.num_class,
@@ -385,10 +387,11 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             margin = margin + put(init_arr)
     else:
         margin = put(np.full((n,), base, dtype=np.float32))
-        if init_margin_arr is not None:
-            margin = margin + put(init_margin_arr[:, 0].astype(np.float32))
         if init_scores is not None:
             margin = margin + put(np.asarray(init_scores, dtype=np.float32))
+        margin_no_continuation = margin
+        if init_margin_arr is not None:
+            margin = margin + put(init_margin_arr[:, 0].astype(np.float32))
 
     # validation margins maintained incrementally on binned valid rows
     has_valid = valid is not None
@@ -453,7 +456,11 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         parts, stop_at = [], None
         best_metric, best_iter, rounds_since = None, -1, 0
         it = 0
-        margin_init = margin  # rf gradients stay at the pre-loop margin
+        # rf gradients stay at the pre-loop margin EXCLUDING any restored
+        # ensemble: resumed rf trees must fit the same bagged target as the
+        # first half, not the half-forest's residuals
+        margin_init = (margin_no_continuation if rf and init_booster is not None
+                       else margin)
         while it < p.num_iterations:
             clen = min(chunk, p.num_iterations - it)
             key, kc = jax.random.split(key)
@@ -471,7 +478,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                               _sf.shape[0] // max(k_out, 1))
                 checkpoint_fn(it + clen, _build_booster(
                     _sf, _sb, _lv, _tc, mapper, p, k_out, n_features, -1,
-                    init_booster, base, gain=_gn, cover=_cv), base)
+                    init_booster, base, gain=_gn, cover=_cv), base,
+                    final=False)
             if track:
                 for i, mv in enumerate(np.asarray(mts)):
                     mv = float(mv)
@@ -496,6 +504,16 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             keep = stop_at * k_out
             sf, sb, lv = sf[:keep], sb[:keep], lv[:keep]
             gn, cv = gn[:keep], cv[:keep]
+            if checkpoint_fn is not None:
+                # overwrite the overgrown chunk checkpoint with the truncated
+                # state and mark training COMPLETE so a re-fit doesn't
+                # continue past the early stop
+                tc_ = np.tile(np.arange(k_out, dtype=np.int32),
+                              sf.shape[0] // max(k_out, 1))
+                checkpoint_fn(stop_at, _build_booster(
+                    sf, sb, lv, tc_, mapper, p, k_out, n_features,
+                    best_iter, init_booster, base, gain=gn, cover=cv),
+                    base, final=True)
         tree_classes = np.tile(np.arange(k_out, dtype=np.int32),
                                sf.shape[0] // max(k_out, 1))
         booster = _build_booster(
@@ -509,7 +527,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     val_deltas: list = []  # per-iteration val-set deltas (DART reweighting)
     best_metric, best_iter, rounds_since = None, -1, 0
     eval_history = []
-    init_margin = margin
+    init_margin = (margin_no_continuation
+                   if rf and init_booster is not None else margin)
 
     n_grown = 0
     for it in range(p.num_iterations):
@@ -654,7 +673,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             checkpoint_fn(it + 1, _build_booster(
                 _sf, _sb, _lv, np.asarray(tree_classes, np.int32), mapper, p,
                 k_out, n_features, -1, init_booster, base, gain=_gn,
-                cover=_cv), base)
+                cover=_cv), base, final=False)
 
     max_nodes = 2 ** (p.max_depth + 1) - 1
     T = len(trees)
@@ -666,7 +685,12 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     if dart and T:
         per_iter_w = np.repeat(np.asarray(dart_weights, np.float32), k_out)
         lv = lv * per_iter_w[:, None]
-    return _build_booster(
+    final_booster = _build_booster(
         sf, sb, lv, np.asarray(tree_classes, np.int32), mapper, p, k_out,
         n_features, best_iter if p.early_stopping_round > 0 else -1,
-        init_booster, base, gain=gn, cover=cv), base, eval_history
+        init_booster, base, gain=gn, cover=cv)
+    if (checkpoint_fn is not None and p.early_stopping_round > 0
+            and rounds_since >= p.early_stopping_round):
+        # early stop: persist the truncated model and mark training complete
+        checkpoint_fn(n_grown, final_booster, base, final=True)
+    return final_booster, base, eval_history

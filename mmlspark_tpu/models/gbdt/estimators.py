@@ -157,15 +157,33 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                 resume_booster = _B.load_model_string(str(payload["booster"]))
                 done = int(payload["iteration"])
                 resume_base = float(payload.get("base", 0.0))
-            remaining = max(params.num_iterations - done, 0)
+                if payload.get("final"):
+                    # training completed (possibly early-stopped): the
+                    # checkpoint IS the final model
+                    return resume_booster, resume_base, []
+            total = params.num_iterations
+            if (resume_booster is not None and self.boosting == "rf"):
+                # restored rf leaves embed 1/denom averaging weights from the
+                # run that built them; extending the forest to a new total
+                # rescales them to 1/total (crash-resume: denom == total,
+                # no-op)
+                denom = int(payload.get("rf_denom", total))
+                if denom != total:
+                    resume_booster = resume_booster._replace(
+                        leaf_value=(resume_booster.leaf_value
+                                    * (denom / total)).astype(np.float32))
+            remaining = max(total - done, 0)
             # rf averaging weights must stay 1/TOTAL across the resume split
             params = dataclasses.replace(params, num_iterations=remaining,
-                                         rf_total=params.num_iterations)
+                                         rf_total=total)
 
-            def ck_fn(it, booster, fit_base, _mgr=mgr, _done=done):
+            def ck_fn(it, booster, fit_base, final=False, _mgr=mgr,
+                      _done=done, _denom=params.rf_total or
+                      params.num_iterations):
                 _mgr.save(_done + it,
                           {"booster": booster.save_model_string(),
-                           "iteration": _done + it, "base": float(fit_base)})
+                           "iteration": _done + it, "base": float(fit_base),
+                           "final": bool(final), "rf_denom": int(_denom)})
             if remaining == 0:
                 return resume_booster, resume_base, []
         if self.parallelism and self._use_mesh():

@@ -17,13 +17,18 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(__file__)
-_SO_PATH = os.path.join(_HERE, "_native.so")
+# the library lives in a NON-package subdir: pkgutil walkers (e.g. the fuzz
+# meta-test) import every module in package dirs, and a raw shared object is
+# not a CPython extension module
+_BUILD_DIR = os.path.join(_HERE, "build")
+_SO_PATH = os.path.join(_BUILD_DIR, "_native.so")
 _lock = threading.Lock()
 _lib = None
 _build_failed = False
 
 
 def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
     src = os.path.join(_HERE, "kernels.cpp")
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
            "-o", _SO_PATH]
@@ -58,7 +63,7 @@ def _load():
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
         lib.parse_csv_floats.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_void_p, ctypes.c_int64]
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
         lib.parse_csv_floats.restype = ctypes.c_int64
         _lib = lib
         return _lib
@@ -105,15 +110,20 @@ def apply_bins_native(x: np.ndarray, upper_bounds: np.ndarray,
 
 
 def parse_csv_native(text: bytes, cols: int, skip_rows: int = 0,
-                     max_rows: int = None):
-    """Parse comma-separated float rows; unparseable fields become NaN.
-    None if unavailable."""
+                     max_rows: int = None, return_clean: bool = False):
+    """Parse comma-separated float rows; empty/unparseable fields become NaN.
+    With return_clean, also returns a (cols,) bool array that is False for
+    columns containing non-numeric text (incl. prefix-numeric strings like
+    dates). None if unavailable."""
     lib = _load()
     if lib is None:
         return None
     buf = np.frombuffer(text, np.uint8) if text else np.zeros(1, np.uint8)
     cap = max_rows if max_rows is not None else text.count(b"\n") + 1
     out = np.empty((cap, cols), np.float32)
+    clean = np.ones(cols, np.int64)
     n = lib.parse_csv_floats(buf.ctypes.data, len(text), cols, skip_rows,
-                             out.ctypes.data, cap)
+                             out.ctypes.data, cap, clean.ctypes.data)
+    if return_clean:
+        return out[:n].copy(), clean.astype(bool)
     return out[:n].copy()

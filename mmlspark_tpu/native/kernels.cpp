@@ -79,35 +79,55 @@ void apply_bins(const float* x, int64_t n, int64_t f,
 }
 
 // ---------------------------------------------------------------- CSV
-// Minimal fast CSV float parser: comma/whitespace separated, one row per
-// line, `cols` columns. Unparseable fields become NaN. Returns rows parsed.
+// Minimal fast CSV float parser: comma separated, one row per line, `cols`
+// columns. Parsing is BOUNDED to each line (strtof would otherwise walk
+// through '\n' into the next row on short/empty fields). Empty/unparseable
+// fields become NaN. col_clean[c] is cleared when any field of column c was
+// non-empty but did not fully parse as a number (e.g. "2024-01-01" prefix-
+// parses to 2024 — the caller must treat that column as text). Returns rows.
 int64_t parse_csv_floats(const char* buf, int64_t len, int64_t cols,
-                         int64_t skip_rows, float* out, int64_t max_rows) {
+                         int64_t skip_rows, float* out, int64_t max_rows,
+                         int64_t* col_clean) {
   const char* p = buf;
   const char* end = buf + len;
-  // skip header rows
   for (int64_t s = 0; s < skip_rows && p < end; s++) {
     while (p < end && *p != '\n') p++;
     if (p < end) p++;
   }
+  if (col_clean) {
+    for (int64_t c = 0; c < cols; c++) col_clean[c] = 1;
+  }
   int64_t row = 0;
   while (p < end && row < max_rows) {
-    // skip empty lines
-    if (*p == '\n') { p++; continue; }
+    const char* line_end = p;
+    while (line_end < end && *line_end != '\n') line_end++;
+    if (line_end == p) { p++; continue; }  // empty line
     for (int64_t c = 0; c < cols; c++) {
-      char* next = nullptr;
-      float v = strtof(p, &next);
-      if (next == p) {  // unparseable (e.g. text) -> NaN, skip field
-        v = __builtin_nanf("");
-        while (p < end && *p != ',' && *p != '\n') p++;
-      } else {
-        p = next;
+      float v = __builtin_nanf("");
+      if (p < line_end) {
+        // field = [p, next ',' or line_end)
+        const char* field_end = p;
+        while (field_end < line_end && *field_end != ',') field_end++;
+        char* next = nullptr;
+        float parsed = strtof(p, &next);
+        if (next != p && next <= field_end) {
+          const char* q = next;  // allow trailing spaces only
+          while (q < field_end && (*q == ' ' || *q == '\r' || *q == '\t')) q++;
+          if (q == field_end) {
+            v = parsed;
+          } else if (col_clean) {
+            col_clean[c] = 0;  // prefix-numeric text ("2024-01-01")
+          }
+        } else if (next == p && col_clean) {
+          const char* q = p;  // non-empty unparseable field -> text column
+          while (q < field_end && (*q == ' ' || *q == '\r' || *q == '\t')) q++;
+          if (q != field_end) col_clean[c] = 0;
+        }
+        p = field_end + (field_end < line_end ? 1 : 0);
       }
       out[row * cols + c] = v;
-      if (p < end && *p == ',') p++;
     }
-    while (p < end && *p != '\n') p++;  // discard extra fields
-    if (p < end) p++;
+    p = line_end + (line_end < end ? 1 : 0);
     row++;
   }
   return row;

@@ -35,7 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE_ROWS = 4096
-FEATURE_BLOCK = 8
+FEATURE_BLOCK = 16
 M_MAX = 64  # max nodes per level handled here (VMEM bound on the 3m columns)
 
 

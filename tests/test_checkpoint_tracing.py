@@ -102,3 +102,43 @@ def test_rf_resume_keeps_total_averaging_weight(reg_data, tmp_path):
     lv_res = np.abs(resumed.booster.leaf_value[8:]).max()
     lv_full = np.abs(full.booster.leaf_value[8:]).max()
     assert lv_res < lv_full * 1.6 + 1e-6, (lv_res, lv_full)
+
+
+def test_rf_resume_matches_gradient_target(reg_data, tmp_path):
+    """Resumed rf trees must fit the original bagged target (gradients at the
+    base margin), not the restored half-forest's residuals: per-tree leaf
+    scale of the resumed half matches an uninterrupted run."""
+    from mmlspark_tpu.models.gbdt import GBDTRegressor
+    ck = str(tmp_path / "rf2")
+    GBDTRegressor(num_iterations=6, boosting="rf", bagging_fraction=0.8,
+                  seed=9, checkpoint_dir=ck, checkpoint_interval=3).fit(reg_data)
+    resumed = GBDTRegressor(num_iterations=12, boosting="rf",
+                            bagging_fraction=0.8, seed=9, checkpoint_dir=ck,
+                            checkpoint_interval=3).fit(reg_data)
+    full = GBDTRegressor(num_iterations=12, boosting="rf",
+                         bagging_fraction=0.8, seed=9).fit(reg_data)
+    y = np.asarray(reg_data["label"])
+    mse_res = float(np.mean((resumed.transform(reg_data)["prediction"] - y) ** 2))
+    mse_full = float(np.mean((full.transform(reg_data)["prediction"] - y) ** 2))
+    # same target => same quality ballpark (bagging draws differ by rng path)
+    assert mse_res < mse_full * 1.3 + 0.05, (mse_res, mse_full)
+
+
+def test_early_stop_checkpoint_is_final(reg_data, tmp_path):
+    """After an early-stopped fit, the checkpoint is marked complete: a
+    re-fit returns the truncated model instead of training past the stop."""
+    from mmlspark_tpu.models.gbdt import GBDTRegressor
+    ck = str(tmp_path / "es")
+    ind = np.zeros(len(reg_data), bool)
+    ind[::5] = True
+    t = reg_data.with_column("val", ind)
+    kw = dict(num_iterations=200, early_stopping_round=3, seed=2,
+              validation_indicator_col="val", checkpoint_dir=ck,
+              checkpoint_interval=5)
+    m1 = GBDTRegressor(**kw).fit(t)
+    n1 = m1.booster.n_trees
+    assert n1 < 200
+    mgr = CheckpointManager(ck)
+    assert mgr.restore()["final"] is True
+    m2 = GBDTRegressor(**kw).fit(t)
+    assert m2.booster.n_trees == n1  # no extra training

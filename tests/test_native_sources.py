@@ -96,3 +96,24 @@ def test_csv_throughput_sanity(tmp_path):
     t_numpy = time.perf_counter() - t0
     np.testing.assert_allclose(out, ref, rtol=1e-5)
     assert t_native < t_numpy, (t_native, t_numpy)
+
+
+def test_native_csv_short_rows_stay_bounded():
+    """A short/empty trailing field must NOT consume the next row
+    (strtof walks through newlines unless parsing is line-bounded)."""
+    out = native.parse_csv_native(b"a,b,c\n1,2,\n4,5,6\n", 3, skip_rows=1)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(out[0, :2], [1, 2])
+    assert np.isnan(out[0, 2])
+    np.testing.assert_allclose(out[1], [4, 5, 6])
+
+
+def test_read_csv_prefix_numeric_strings_are_text(tmp_path):
+    """Dates like 2024-01-01 prefix-parse as floats; the clean-column flags
+    must force them back to text."""
+    p = tmp_path / "dates.csv"
+    p.write_text("x,date,y\n1.0,2024-01-01,10\n2.0,2024-02-01,20\n")
+    t = read_csv(str(p))
+    assert list(t["date"]) == ["2024-01-01", "2024-02-01"]
+    np.testing.assert_allclose(t["x"], [1.0, 2.0])
+    np.testing.assert_allclose(t["y"], [10, 20])
