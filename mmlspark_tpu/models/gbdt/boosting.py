@@ -373,9 +373,6 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     margin_no_continuation = None  # rf: gradients target y, not residuals
     if multiclass:
         margin = put(np.zeros((n, p.num_class), dtype=np.float32))
-        margin_no_continuation = margin
-        if init_margin_arr is not None:
-            margin = margin + put(init_margin_arr.astype(np.float32))
         y_onehot = jax.nn.one_hot(y_j.astype(jnp.int32), p.num_class,
                                   dtype=jnp.float32)
         if init_scores is not None:
@@ -385,6 +382,11 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                     f"multiclass init_scores must be (n, num_class)="
                     f"({n}, {p.num_class}), got {init_arr.shape}")
             margin = margin + put(init_arr)
+        # captured AFTER init_scores: resumed-rf gradients target the
+        # init_scores baseline, excluding only the restored ensemble
+        margin_no_continuation = margin
+        if init_margin_arr is not None:
+            margin = margin + put(init_margin_arr.astype(np.float32))
     else:
         margin = put(np.full((n,), base, dtype=np.float32))
         if init_scores is not None:

@@ -183,7 +183,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                 _mgr.save(_done + it,
                           {"booster": booster.save_model_string(),
                            "iteration": _done + it, "base": float(fit_base),
-                           "final": bool(final), "rf_denom": int(_denom)})
+                           "final": bool(final), "rf_denom": int(_denom)},
+                          prune_newer=final)
             if remaining == 0:
                 return resume_booster, resume_base, []
         if self.parallelism and self._use_mesh():

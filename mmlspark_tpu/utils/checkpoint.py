@@ -45,10 +45,14 @@ class CheckpointManager:
         return os.path.join(self.directory, f"step_{step}")
 
     # -- save/restore -------------------------------------------------------
-    def save(self, step: int, payload: dict) -> None:
+    def save(self, step: int, payload: dict,
+             prune_newer: bool = False) -> None:
         """Write arrays to npz + scalars/strings to JSON, atomically: the
         step directory appears only when complete (tmp dir + os.replace),
-        so a killed process never leaves a half checkpoint."""
+        so a killed process never leaves a half checkpoint. prune_newer
+        removes steps beyond this one (a truncating save — e.g. early
+        stopping rewinding past already-checkpointed work — must not leave
+        a higher step to shadow it as latest)."""
         arrays, meta = {}, {}
         for k, v in payload.items():
             if isinstance(v, np.ndarray):
@@ -69,6 +73,9 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        if prune_newer:
+            for newer in [s for s in self.all_steps() if s > step]:
+                shutil.rmtree(self._step_dir(newer), ignore_errors=True)
         # retention
         steps = self.all_steps()
         for old in steps[: max(len(steps) - self.max_to_keep, 0)]:

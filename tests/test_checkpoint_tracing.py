@@ -142,3 +142,23 @@ def test_early_stop_checkpoint_is_final(reg_data, tmp_path):
     assert mgr.restore()["final"] is True
     m2 = GBDTRegressor(**kw).fit(t)
     assert m2.booster.n_trees == n1  # no extra training
+
+
+def test_early_stop_final_checkpoint_prunes_newer_steps(reg_data, tmp_path):
+    """An early stop mid-chunk must not leave a higher non-final chunk
+    checkpoint shadowing the truncated final one."""
+    from mmlspark_tpu.models.gbdt import GBDTRegressor
+    ck = str(tmp_path / "es2")
+    ind = np.zeros(len(reg_data), bool)
+    ind[::4] = True
+    t = reg_data.with_column("val", ind)
+    kw = dict(num_iterations=300, early_stopping_round=4, seed=8,
+              validation_indicator_col="val", checkpoint_dir=ck,
+              checkpoint_interval=7)  # not aligned with the stop point
+    m1 = GBDTRegressor(**kw).fit(t)
+    mgr = CheckpointManager(ck)
+    payload = mgr.restore()  # latest MUST be the final truncated state
+    assert payload["final"] is True
+    assert int(payload["iteration"]) * 1 == m1.booster.n_trees
+    m2 = GBDTRegressor(**kw).fit(t)
+    assert m2.booster.n_trees == m1.booster.n_trees

@@ -1,0 +1,24 @@
+"""Codegen tests (reference: codegen/ generates wrappers from stage params;
+here the artifacts are .pyi stubs + a Markdown API reference)."""
+import numpy as np
+
+from mmlspark_tpu import codegen
+
+
+def test_stubs_cover_registered_stages(tmp_path):
+    stubs = codegen.generate_stubs()
+    assert any("gbdt" in m for m in stubs)
+    gbdt = next(v for k, v in stubs.items() if k.endswith("gbdt.estimators"))
+    assert "class GBDTClassifier" in gbdt
+    assert "num_iterations: int" in gbdt
+    paths = codegen.write_artifacts(str(tmp_path))
+    assert any(p.endswith("API.md") for p in paths)
+    assert len(paths) > 20
+
+
+def test_api_markdown_has_param_docs():
+    md = codegen.generate_api_markdown()
+    assert "### GBDTClassifier (Estimator)" in md
+    assert "`num_leaves`" in md
+    assert "### StratifiedRepartition (Transformer)" in md
+    assert "### SARModel (Model)" in md
