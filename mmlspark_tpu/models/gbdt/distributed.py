@@ -24,21 +24,9 @@ from typing import Optional
 
 import jax
 import numpy as np
-try:  # jax >= 0.4.35 promotes shard_map out of experimental
-    import inspect as _inspect
-    from jax import shard_map as _shard_map
-    _CHECK_KW = ("check_vma" if "check_vma"
-                 in _inspect.signature(_shard_map).parameters else "check_rep")
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
-    _CHECK_KW = "check_rep"
-
-
-def shard_map(fn, **kw):
-    """Version-tolerant shard_map (check_rep was renamed check_vma)."""
-    kw[_CHECK_KW] = kw.pop("check_rep", False)
-    return _shard_map(fn, **kw)
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...parallel.shard import shard_map
 
 from ...parallel import DATA_AXIS, data_mesh, pad_to_multiple
 from . import trainer

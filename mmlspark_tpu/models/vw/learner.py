@@ -264,14 +264,8 @@ def fit_vw(idx: np.ndarray, val: np.ndarray, y: np.ndarray,
             val_p, _ = pad_to_multiple(val, nsh)
             y_p, _ = pad_to_multiple(np.asarray(y, np.float32), nsh)
             wr_p, _ = pad_to_multiple(w_row, nsh)  # pad weight 0 -> no loss
-            try:
-                from jax import shard_map as _smap_mod
-            except ImportError:
-                from jax.experimental.shard_map import shard_map as _smap_mod
             from jax.sharding import PartitionSpec as P
-            import inspect
-            kw = {"check_vma" if "check_vma" in
-                  inspect.signature(_smap_mod).parameters else "check_rep": False}
+            from ...parallel.shard import shard_map as _smap
 
             def local_fit(li, lv, ly, lw):
                 bi, bv, by, bw, nb_l = _jitless_batches(li, lv, ly, lw,
@@ -279,11 +273,11 @@ def fit_vw(idx: np.ndarray, val: np.ndarray, y: np.ndarray,
                 return _fit_sgd(bi, bv, by, bw, params, nb_l, init_w, init_b,
                                 axis_name=DATA_AXIS)
 
-            mapped = _smap_mod(
+            mapped = _smap(
                 local_fit, mesh=mesh,
                 in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
                           P(DATA_AXIS), P(DATA_AXIS)),
-                out_specs=(P(), P(), P()), **kw)
+                out_specs=(P(), P(), P()), check_rep=False)
             w_out, b_out, losses = jax.jit(mapped)(
                 jnp.asarray(idx_p), jnp.asarray(val_p), jnp.asarray(y_p),
                 jnp.asarray(wr_p))

@@ -1,7 +1,8 @@
 from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, data_mesh, grid_mesh,
                    full_mesh, row_sharding, replicated, pad_to_multiple,
                    shard_rows, valid_row_mask, device_count)
+from .shard import shard_map
 
 __all__ = ["DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "data_mesh", "grid_mesh",
            "full_mesh", "row_sharding", "replicated", "pad_to_multiple",
-           "shard_rows", "valid_row_mask", "device_count"]
+           "shard_rows", "valid_row_mask", "device_count", "shard_map"]

@@ -1,0 +1,178 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+SURVEY.md §5 flags long-context/sequence parallelism as ABSENT in the
+reference ("the TPU build's CP/SP story must be designed fresh — ring
+collectives over ICI via shard_map + ppermute, not ported"). This module is
+that design:
+
+- `ring_attention`: blockwise attention over a sequence-sharded mesh axis.
+  Each device holds one sequence block of Q/K/V; K/V blocks rotate around
+  the ring with `lax.ppermute` while a flash-style streaming softmax
+  (running max + denominator) accumulates exact attention — memory per
+  device stays O(block^2) and the K/V transfer rides ICI neighbor links,
+  never DCN. Causal masking uses the rotating block's global offset.
+- `ulysses_attention`: the all-to-all alternative (DeepSpeed-Ulysses
+  layout): `all_to_all` re-shards sequence -> heads, every device runs
+  dense attention for its head subset over the FULL sequence, and a second
+  `all_to_all` restores sequence sharding. Better when heads >= devices and
+  block attention would underutilize the MXU.
+
+Both are exact (not approximations) and verified against single-device
+softmax attention on the virtual mesh in tests/test_ring_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import DATA_AXIS
+from .shard import shard_map  # version-tolerant wrapper
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attend(q, k, v, mask):
+    """Scores for one (q-block, kv-block) pair + streaming-softmax stats.
+    q (B, H, D), k/v (Bk, H, D), mask (B, Bk) additive."""
+    s = jnp.einsum("qhd,khd->hqk", q, k)                    # (H, B, Bk)
+    s = s + mask[None, :, :]
+    # finite floor: a fully-masked block row has max -inf, and
+    # exp(-inf - -inf) would be NaN — clamp so its probs are exactly 0
+    m = jnp.maximum(jnp.max(s, axis=-1), -1e30)             # (H, B)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                                 # (H, B)
+    o = jnp.einsum("hqk,khd->qhd", p, v)                    # (B, H, D)
+    return o, m, l
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
+                            scale: float):
+    """Runs INSIDE shard_map: q/k/v are the local (block, H, D) shards."""
+    n_dev = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    block = q.shape[0]
+    h = q.shape[1]
+    q = q * scale
+
+    def step(carry, i):
+        k_blk, v_blk, acc, m_run, l_run = carry
+        # global index of the K/V block currently held: it started at
+        # (my_idx + i) ... ppermute below shifts blocks DOWN the ring, so at
+        # step i we hold the block originally owned by (my_idx + i) % n_dev
+        src = (my_idx + i) % n_dev
+        if causal:
+            q_pos = my_idx * block + jnp.arange(block)
+            k_pos = src * block + jnp.arange(block)
+            mask = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
+        else:
+            mask = jnp.zeros((block, block), q.dtype)
+        o, m_blk, l_blk = _block_attend(q, k_blk, v_blk, mask)
+        # streaming softmax merge (flash-attention accumulator)
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)                      # rescale old
+        beta = jnp.exp(m_blk - m_new)                       # rescale new
+        l_new = l_run * alpha + l_blk * beta
+        acc = acc * alpha.T[:, :, None] + o * beta.T[:, :, None]
+        # rotate K/V to the next device (ICI neighbor exchange)
+        perm = [(j, (j - 1) % n_dev) for j in range(n_dev)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, acc, m_new, l_new), None
+
+    acc0 = jnp.zeros_like(q)
+    m0 = jnp.full((h, block), -1e30, q.dtype)  # finite: see _block_attend
+    l0 = jnp.zeros((h, block), q.dtype)
+    (k, v, acc, m_run, l_run), _ = jax.lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(n_dev))
+    return acc / jnp.maximum(l_run, 1e-30).T[:, :, None]
+
+
+def ring_attention(q, k, v, mesh=None, axis: str = DATA_AXIS,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Exact attention over a sequence sharded across `mesh`'s `axis`.
+
+    q/k/v: (seq, heads, dim) with seq divisible by the axis size. Returns
+    (seq, heads, dim) with the same sharding.
+    """
+    from . import data_mesh
+    mesh = mesh or data_mesh()
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    fn = functools.partial(_ring_attention_sharded, axis_name=axis,
+                           causal=causal, scale=scale)
+    mapped = shard_map(fn, mesh=mesh,
+                       in_specs=(P(axis), P(axis), P(axis)),
+                       out_specs=P(axis), check_rep=False)
+    return jax.jit(mapped)(q, k, v)
+
+
+def _ulysses_sharded(q, k, v, axis_name: str, causal: bool, scale: float,
+                     n_dev: int):
+    """Runs INSIDE shard_map: sequence-sharded in, sequence-sharded out.
+    all_to_all trades the sequence shard for a heads shard, so each device
+    attends over the FULL sequence for heads/n_dev heads."""
+    # (block, H, D) -> (block, n_dev, H/n_dev, D) -> all_to_all over axis 1
+    block, h, d = q.shape
+
+    def to_heads(x):
+        x = x.reshape(block, n_dev, h // n_dev, d)
+        # concat_dimension gathers the seq blocks: (seq, H/n_dev, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=0, tiled=True).reshape(
+            block * n_dev, h // n_dev, d)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    seq = qh.shape[0]
+    if causal:
+        pos = jnp.arange(seq)
+        mask = jnp.where(pos[:, None] >= pos[None, :], 0.0, -jnp.inf)
+    else:
+        mask = jnp.zeros((seq, seq), q.dtype)
+    o, _, l = _block_attend(qh * scale, kh, vh, mask)
+    o = o / jnp.maximum(l, 1e-30).T[:, :, None]             # (seq, H/n, D)
+    # back: heads shard -> sequence shard. Splitting axis 0 sends block j to
+    # device j; concatenating along the HEAD axis (2) reassembles the full
+    # head dim in source (= global head group) order.
+    o = o.reshape(n_dev, block, h // n_dev, d)
+    o = jax.lax.all_to_all(o, axis_name, split_axis=0, concat_axis=2,
+                           tiled=True)
+    return o.reshape(block, h, d)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis: str = DATA_AXIS,
+                      causal: bool = False, scale: Optional[float] = None):
+    """All-to-all sequence parallelism (Ulysses layout); requires
+    heads % axis_size == 0. Same contract as ring_attention."""
+    from . import data_mesh
+    mesh = mesh or data_mesh()
+    n_dev = mesh.shape[axis]
+    if q.shape[1] % n_dev:
+        raise ValueError(
+            f"ulysses_attention needs heads ({q.shape[1]}) divisible by the "
+            f"mesh axis size ({n_dev}); use ring_attention otherwise")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    fn = functools.partial(_ulysses_sharded, axis_name=axis, causal=causal,
+                           scale=scale, n_dev=n_dev)
+    mapped = shard_map(fn, mesh=mesh,
+                       in_specs=(P(axis), P(axis), P(axis)),
+                       out_specs=P(axis), check_rep=False)
+    return jax.jit(mapped)(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Single-device oracle used by tests and small inputs."""
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    s = jnp.einsum("qhd,khd->hqk", q * scale, k)
+    if causal:
+        n = q.shape[0]
+        mask = jnp.where(jnp.arange(n)[:, None] >= jnp.arange(n)[None, :],
+                         0.0, -jnp.inf)
+        s = s + mask[None]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v)

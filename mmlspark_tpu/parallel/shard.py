@@ -1,0 +1,17 @@
+"""Version-tolerant shard_map (jax renamed check_rep -> check_vma and
+promoted shard_map out of experimental)."""
+from __future__ import annotations
+
+try:  # jax >= 0.4.35
+    import inspect as _inspect
+    from jax import shard_map as _shard_map
+    _CHECK_KW = ("check_vma" if "check_vma"
+                 in _inspect.signature(_shard_map).parameters else "check_rep")
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(fn, **kw):
+    kw[_CHECK_KW] = kw.pop("check_rep", False)
+    return _shard_map(fn, **kw)
