@@ -334,3 +334,73 @@ def test_custom_fobj_matches_builtin(cancer):
     pb = np.asarray(builtin.transform(test)["raw_prediction"])
     pc = np.asarray(custom.transform(test)["raw_prediction"])
     assert np.allclose(pb, pc, atol=1e-4)
+
+
+def test_predict_nan_routes_right_like_binning():
+    """NaN features must route to the RIGHT child (missing = largest bin,
+    ops/binning semantics) in the select-chain predict path, matching the
+    model's own training-time margins."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.gbdt import trainer
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(500, 4)).astype(np.float32)
+    x[::7, 1] = np.nan
+    y = (np.nan_to_num(x[:, 1], nan=3.0) + x[:, 0] > 0).astype(np.float32)
+    params = BoostParams(num_iterations=10, max_depth=4, min_data_in_leaf=5,
+                         max_bin=63)
+    booster, base, _ = fit_booster(x, y, params)
+    from mmlspark_tpu.ops import binning
+    # identical binning to training: raw-threshold and binned scoring agree
+    mapper = binning.fit_bins(x, max_bin=params.max_bin, seed=params.seed)
+    bins = binning.apply_bins(mapper, x)
+    # raw-feature scoring must agree with binned scoring (which follows the
+    # training-time NaN->last-bin routing) tree by tree
+    total_binned = np.zeros(x.shape[0], np.float32)
+    for t in range(booster.n_trees):
+        total_binned += np.asarray(trainer.predict_binned(
+            jnp.asarray(bins), jnp.asarray(booster.split_feature[t]),
+            jnp.asarray(booster.split_bin[t]),
+            jnp.asarray(booster.leaf_value[t]), booster.max_depth))
+    raw = booster.raw_score(x)[:, 0]
+    np.testing.assert_allclose(raw, total_binned, rtol=1e-4, atol=1e-5)
+
+
+def test_predict_leaf_matches_gather_descent():
+    """The select-chain leaf-index path must report the ORIGINAL resting
+    node ids, identical to the reference gather descent."""
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, 5)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    booster, _, _ = fit_booster(
+        x, y, BoostParams(num_iterations=5, max_depth=4, min_data_in_leaf=3))
+    fast = booster.predict_leaf(x)
+    # oracle: per-row python descent
+    for t in range(booster.n_trees):
+        sf, thr = booster.split_feature[t], booster.threshold[t]
+        for i in range(0, 300, 37):
+            node = 0
+            for _ in range(booster.max_depth):
+                f = sf[node]
+                if f < 0:
+                    break
+                node = 2 * node + 1 if x[i, f] <= thr[node] else 2 * node + 2
+            assert fast[i, t] == node, (t, i)
+
+
+def test_deep_tree_predict_fallback():
+    """max_depth beyond the select-chain limit routes through the gather
+    descent and still scores correctly."""
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (x @ np.arange(1.0, 7.0) > 0).astype(np.float32)
+    booster, _, _ = fit_booster(
+        x, y, BoostParams(num_iterations=5, max_depth=9, min_data_in_leaf=2,
+                          num_leaves=400))
+    raw = booster.raw_score(x)[:, 0]
+    acc = ((raw > 0) == (y > 0.5)).mean()
+    assert acc > 0.9
+    leaves = booster.predict_leaf(x)
+    assert leaves.shape == (400, 5)
