@@ -2,5 +2,9 @@
 from .model import DNNModel
 from .resnet import ResNet, resnet18, resnet50
 from .image_featurizer import ImageFeaturizer
+from .transformer import (TransformerSentenceEncoder, init_transformer,
+                          transformer_apply)
 
-__all__ = ["DNNModel", "ResNet", "resnet18", "resnet50", "ImageFeaturizer"]
+__all__ = ["DNNModel", "ResNet", "resnet18", "resnet50", "ImageFeaturizer",
+           "TransformerSentenceEncoder", "init_transformer",
+           "transformer_apply"]

@@ -164,8 +164,10 @@ def ulysses_attention(q, k, v, mesh=None, axis: str = DATA_AXIS,
 
 
 def reference_attention(q, k, v, causal: bool = False,
-                        scale: Optional[float] = None):
-    """Single-device oracle used by tests and small inputs."""
+                        scale: Optional[float] = None, key_mask=None):
+    """Single-device attention (tests' oracle and the dense path).
+    key_mask: optional (seq,) bool — False keys (e.g. padding) are excluded
+    from every query's softmax."""
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
     s = jnp.einsum("qhd,khd->hqk", q * scale, k)
@@ -174,5 +176,8 @@ def reference_attention(q, k, v, causal: bool = False,
         mask = jnp.where(jnp.arange(n)[:, None] >= jnp.arange(n)[None, :],
                          0.0, -jnp.inf)
         s = s + mask[None]
+    if key_mask is not None:
+        s = s + jnp.where(key_mask, 0.0, -jnp.inf)[None, None, :]
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("hqk,khd->qhd", p, v)
+    # fully-masked rows (empty doc) softmax to NaN -> output 0
+    return jnp.einsum("hqk,khd->qhd", jnp.nan_to_num(p), v)
