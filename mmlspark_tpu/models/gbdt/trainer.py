@@ -372,6 +372,20 @@ def _select_chain_descend(go_right_bits, values, max_depth: int):
 _SELECT_CHAIN_MAX_DEPTH = 8
 
 
+def _chain_score(feat_rows_t, sf_t, thr_t, payload, max_depth: int,
+                 int_thresholds: bool):
+    """Shared select-chain scoring for one tree: slice each node's feature
+    row, compare against its threshold, descend. int thresholds (bins) use
+    plain >; float thresholds use ~(x <= thr) so NaN routes RIGHT
+    (missing = largest, ops/binning semantics)."""
+    xsel = feat_rows_t[jnp.clip(sf_t, 0, feat_rows_t.shape[0] - 1)]
+    if int_thresholds:
+        bits = xsel > thr_t[:, None]
+    else:
+        bits = ~(xsel <= thr_t[:, None])
+    return _select_chain_descend(bits, payload, max_depth)
+
+
 def _heap_ids(sf_stack):
     t, max_nodes = sf_stack.shape
     return jnp.broadcast_to(jnp.arange(max_nodes, dtype=jnp.int32),
@@ -391,10 +405,8 @@ def predict_binned(bins, split_feature, split_bin, leaf_value, max_depth: int):
     sf, sb, lv = _propagate_leaves(
         split_feature[None], split_bin[None].astype(jnp.int32),
         leaf_value[None], max_depth, jnp.int32(2 ** 30))
-    sf_t, sb_t, lv_t = sf[0], sb[0], lv[0]
-    xsel = bins_t[jnp.clip(sf_t, 0, bins.shape[1] - 1)]
-    bits = xsel > sb_t[:, None]  # left iff bin <= split_bin (bins never NaN)
-    return _select_chain_descend(bits, lv_t, max_depth)
+    return _chain_score(bins_t, sf[0], sb[0], lv[0], max_depth,
+                        int_thresholds=True)
 
 
 def _leaf_of_binned_gather(bins, split_feature, split_bin, max_depth: int):
@@ -422,9 +434,8 @@ def leaf_of_binned(bins, split_feature, split_bin, max_depth: int):
         split_feature[None], split_bin[None].astype(jnp.int32),
         jnp.zeros_like(split_bin, jnp.float32)[None], max_depth,
         jnp.int32(2 ** 30), ids=_heap_ids(split_feature[None]))
-    xsel = bins_t[jnp.clip(sf[0], 0, bins.shape[1] - 1)]
-    bits = xsel > sb[0][:, None]
-    return _select_chain_descend(bits, ids[0], max_depth)
+    return _chain_score(bins_t, sf[0], sb[0], ids[0], max_depth,
+                        int_thresholds=True)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_classes"))
@@ -447,14 +458,8 @@ def predict_raw(x, split_feature, threshold, leaf_value, tree_class,
 
     def body(scores, tree):
         sf_t, thr_t, lv_t, tc = tree
-        # (max_nodes, n) feature rows for every node: a 63-row gather from
-        # the (F, n) transpose — contiguous rows, nothing per-row
-        xsel = x_t[jnp.clip(sf_t, 0, x.shape[1] - 1)]
-        # go right unless x <= thr; NaN fails the comparison and therefore
-        # routes RIGHT — matching training-time binning (NaN -> last bin,
-        # ops/binning.py "missing treated as largest")
-        bits = ~(xsel <= thr_t[:, None])
-        val = _select_chain_descend(bits, lv_t, max_depth)
+        val = _chain_score(x_t, sf_t, thr_t, lv_t, max_depth,
+                           int_thresholds=False)
         contrib = val[:, None] * jax.nn.one_hot(tc, n_classes, dtype=lv_t.dtype)
         return scores + contrib, None
 
@@ -503,9 +508,8 @@ def predict_leaf_index(x, split_feature, threshold, max_depth: int):
 
         def body(_, tree):
             sf_t, thr_t, ids_t = tree
-            xsel = x_t[jnp.clip(sf_t, 0, x.shape[1] - 1)]
-            bits = ~(xsel <= thr_t[:, None])  # NaN right, like predict_raw
-            return None, _select_chain_descend(bits, ids_t, max_depth)
+            return None, _chain_score(x_t, sf_t, thr_t, ids_t, max_depth,
+                                      int_thresholds=False)
 
         _, leaves = jax.lax.scan(body, None, (sf, thr, ids))
         return leaves.T  # (n, T)
