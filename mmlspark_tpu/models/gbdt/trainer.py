@@ -372,17 +372,13 @@ def _select_chain_descend(go_right_bits, values, max_depth: int):
 _SELECT_CHAIN_MAX_DEPTH = 8
 
 
-def _chain_score(feat_rows_t, sf_t, thr_t, payload, max_depth: int,
-                 int_thresholds: bool):
+def _chain_score(feat_rows_t, sf_t, thr_t, payload, max_depth: int):
     """Shared select-chain scoring for one tree: slice each node's feature
-    row, compare against its threshold, descend. int thresholds (bins) use
-    plain >; float thresholds use ~(x <= thr) so NaN routes RIGHT
-    (missing = largest, ops/binning semantics)."""
+    row, compare against its threshold, descend. ~(x <= thr) routes NaN
+    RIGHT (missing = largest, ops/binning semantics); for integer bins the
+    form is identical to x > thr."""
     xsel = feat_rows_t[jnp.clip(sf_t, 0, feat_rows_t.shape[0] - 1)]
-    if int_thresholds:
-        bits = xsel > thr_t[:, None]
-    else:
-        bits = ~(xsel <= thr_t[:, None])
+    bits = ~(xsel <= thr_t[:, None])
     return _select_chain_descend(bits, payload, max_depth)
 
 
@@ -405,8 +401,7 @@ def predict_binned(bins, split_feature, split_bin, leaf_value, max_depth: int):
     sf, sb, lv = _propagate_leaves(
         split_feature[None], split_bin[None].astype(jnp.int32),
         leaf_value[None], max_depth, jnp.int32(2 ** 30))
-    return _chain_score(bins_t, sf[0], sb[0], lv[0], max_depth,
-                        int_thresholds=True)
+    return _chain_score(bins_t, sf[0], sb[0], lv[0], max_depth)
 
 
 def _leaf_of_binned_gather(bins, split_feature, split_bin, max_depth: int):
@@ -434,8 +429,7 @@ def leaf_of_binned(bins, split_feature, split_bin, max_depth: int):
         split_feature[None], split_bin[None].astype(jnp.int32),
         jnp.zeros_like(split_bin, jnp.float32)[None], max_depth,
         jnp.int32(2 ** 30), ids=_heap_ids(split_feature[None]))
-    return _chain_score(bins_t, sf[0], sb[0], ids[0], max_depth,
-                        int_thresholds=True)
+    return _chain_score(bins_t, sf[0], sb[0], ids[0], max_depth)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_classes"))
@@ -458,8 +452,7 @@ def predict_raw(x, split_feature, threshold, leaf_value, tree_class,
 
     def body(scores, tree):
         sf_t, thr_t, lv_t, tc = tree
-        val = _chain_score(x_t, sf_t, thr_t, lv_t, max_depth,
-                           int_thresholds=False)
+        val = _chain_score(x_t, sf_t, thr_t, lv_t, max_depth)
         contrib = val[:, None] * jax.nn.one_hot(tc, n_classes, dtype=lv_t.dtype)
         return scores + contrib, None
 
@@ -508,8 +501,7 @@ def predict_leaf_index(x, split_feature, threshold, max_depth: int):
 
         def body(_, tree):
             sf_t, thr_t, ids_t = tree
-            return None, _chain_score(x_t, sf_t, thr_t, ids_t, max_depth,
-                                      int_thresholds=False)
+            return None, _chain_score(x_t, sf_t, thr_t, ids_t, max_depth)
 
         _, leaves = jax.lax.scan(body, None, (sf, thr, ids))
         return leaves.T  # (n, T)

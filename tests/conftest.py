@@ -15,9 +15,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# persistent compile cache: the suite compiles thousands of XLA programs in
+# one process; re-runs load them from disk instead (also sidesteps a
+# rare LLVM crash observed when the same program recompiles late in a
+# long suite process)
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Bound in-process compile-cache growth across the suite (hundreds of
+    jitted programs otherwise accumulate in one process)."""
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="session")
