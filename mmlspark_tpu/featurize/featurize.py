@@ -216,11 +216,15 @@ class FeaturizeModel(Model):
                 val_parts.append(np.ones((n, 1), np.float32))
             offset += width
         o = self.output_col
-        return t.with_columns({
+        out = t.with_columns({
             f"{o}_idx": np.concatenate(idx_parts, axis=1) if idx_parts
             else np.zeros((n, 0), np.int32),
             f"{o}_val": np.concatenate(val_parts, axis=1) if val_parts
             else np.zeros((n, 0), np.float32)})
+        # consumers (linear models, to_dense) read the logical feature-space
+        # width from column metadata instead of guessing from observed ids
+        return out.with_column_meta(f"{o}_idx",
+                                    logical_width=self.num_output_features)
 
 
 class CountSelector(Estimator):

@@ -141,5 +141,7 @@ class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
         if self._dense:
             from ..ops.sparse import to_dense
             return t.with_column(self.output_col, to_dense(idx, val, nf))
-        return t.with_columns({f"{self.output_col}_idx": idx,
-                               f"{self.output_col}_val": val})
+        return (t.with_columns({f"{self.output_col}_idx": idx,
+                                f"{self.output_col}_val": val})
+                 .with_column_meta(f"{self.output_col}_idx",
+                                   logical_width=nf))

@@ -4,6 +4,11 @@ AutoML wrap (TrainClassifier's default learner is logistic regression,
 train/TrainClassifier.scala:49).
 
 One fused lax.scan of optimizer steps per fit: no host loop, TPU-friendly.
+
+Features may be a dense (n, F) matrix OR the framework's sparse pair
+columns `<features>_idx`/`<features>_val` (ops/sparse.py) — hashed 2^18
+featurization trains directly via gathered-weight logits, no dense
+materialization (indices mask into the learned table like VW).
 """
 from __future__ import annotations
 
@@ -18,10 +23,20 @@ from ..core import (Estimator, Model, Param, Table, HasFeaturesCol, HasLabelCol,
                     HasPredictionCol, HasProbabilitiesCol, HasWeightCol)
 
 
-@functools.partial(jax.jit, static_argnames=("n_steps", "n_classes", "kind"))
+def _linear_logits(p, x):
+    """Dense (n, F) matmul, or sparse pair gather-sum when x is a tuple."""
+    if isinstance(x, tuple):
+        idx, val = x
+        width = p["w"].shape[0]  # exact logical width; out-of-range wraps
+        return jnp.einsum("nk,nko->no", val, p["w"][idx % width]) + p["b"]
+    return x @ p["w"] + p["b"]
+
+
+@functools.partial(jax.jit, static_argnames=("n_steps", "n_classes", "kind",
+                                             "n_features"))
 def _fit_linear(x, y, w, n_steps: int, n_classes: int, kind: str,
-                reg_l2: float, lr: float):
-    n, f = x.shape
+                reg_l2: float, lr: float, n_features: int = 0):
+    f = n_features or x.shape[1]
     out_dim = n_classes if kind == "multiclass" else 1
     params = {"w": jnp.zeros((f, out_dim), jnp.float32),
               "b": jnp.zeros((out_dim,), jnp.float32)}
@@ -29,7 +44,7 @@ def _fit_linear(x, y, w, n_steps: int, n_classes: int, kind: str,
     state = opt.init(params)
 
     def loss_fn(p):
-        logits = x @ p["w"] + p["b"]
+        logits = _linear_logits(p, x)
         if kind == "binary":
             ll = optax.sigmoid_binary_cross_entropy(logits[:, 0], y)
         elif kind == "multiclass":
@@ -50,6 +65,23 @@ def _fit_linear(x, y, w, n_steps: int, n_classes: int, kind: str,
     return params
 
 
+def _score_linear(t: Table, features_col: str, w: np.ndarray, b,
+                  sparse_trained: bool) -> np.ndarray:
+    """(n, out_dim) logits from a dense features column or a sparse pair."""
+    if features_col not in t and f"{features_col}_idx" in t:
+        if not sparse_trained:
+            raise TypeError(
+                f"this model was trained on a dense {features_col!r} matrix; "
+                f"scoring sparse pair columns would remap feature indices — "
+                f"densify via ops.sparse.to_dense or retrain on sparse input")
+        idx = np.asarray(t[f"{features_col}_idx"], np.int64)
+        val = np.asarray(t[f"{features_col}_val"], np.float32)
+        width = w.shape[0]
+        return np.einsum("nk,nko->no", val, w[idx % width]) + b
+    x = np.asarray(t[features_col], np.float32)
+    return x @ w + b
+
+
 class _LinearBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol,
                   HasPredictionCol):
     max_iter = Param("max_iter", "optimizer steps", 300)
@@ -57,13 +89,44 @@ class _LinearBase(Estimator, HasFeaturesCol, HasLabelCol, HasWeightCol,
     learning_rate = Param("learning_rate", "adam step size", 0.1)
 
     def _data(self, t: Table):
-        x = jnp.asarray(np.asarray(t[self.features_col], np.float32))
+        fc = self.features_col
+        if fc not in t and f"{fc}_idx" in t:
+            # sparse pair columns: weight table sized to the next power of
+            # two above the max index; serve-time indices wrap (VW-style)
+            idx = jnp.asarray(np.asarray(t[f"{fc}_idx"], np.int32))
+            val = jnp.asarray(np.asarray(t[f"{fc}_val"], np.float32))
+            x = (idx, val)
+        else:
+            x = jnp.asarray(np.asarray(t[fc], np.float32))
         y = jnp.asarray(np.asarray(t[self.label_col], np.float32))
+        n = y.shape[0]
         if self.weight_col and self.weight_col in t:
             w = jnp.asarray(np.asarray(t[self.weight_col], np.float32))
         else:
-            w = jnp.ones(x.shape[0], jnp.float32)
+            w = jnp.ones(n, jnp.float32)
         return x, y, w
+
+    def _table_width(self, t: Table, x) -> int:
+        """Weight-table rows: F for dense; for sparse, the logical width the
+        featurizer stamped on the idx column's metadata (falling back to the
+        observed max with a warning — sample-dependent widths risk serve-time
+        wrapping onto unrelated features)."""
+        if not isinstance(x, tuple):
+            return int(x.shape[1])
+        meta_width = t.column_meta(
+            f"{self.features_col}_idx").get("logical_width")
+        if meta_width:
+            return int(meta_width)
+        idx = np.asarray(x[0])
+        if idx.size == 0:
+            return 1
+        import warnings
+        warnings.warn(
+            f"sparse column {self.features_col!r}_idx carries no "
+            f"logical_width metadata; sizing the weight table from the "
+            f"observed max index — serve-time indices beyond it will wrap",
+            stacklevel=2)
+        return int(idx.max()) + 1
 
 
 class LogisticRegression(_LinearBase, HasProbabilitiesCol):
@@ -73,11 +136,14 @@ class LogisticRegression(_LinearBase, HasProbabilitiesCol):
         x, y, w = self._data(t)
         k = self.num_classes or int(np.asarray(y).max()) + 1
         kind = "binary" if k <= 2 else "multiclass"
+        width = self._table_width(t, x)
         params = _fit_linear(x, y, w, self.max_iter, k, kind,
-                             self.reg_param, self.learning_rate)
+                             self.reg_param, self.learning_rate,
+                             n_features=width)
         m = LogisticRegressionModel(
             features_col=self.features_col, prediction_col=self.prediction_col,
-            probabilities_col=self.probabilities_col, n_classes=k)
+            probabilities_col=self.probabilities_col, n_classes=k,
+            sparse_trained=isinstance(x, tuple))
         m._w = np.asarray(params["w"])
         m._b = np.asarray(params["b"])
         return m
@@ -86,6 +152,8 @@ class LogisticRegression(_LinearBase, HasProbabilitiesCol):
 class LogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol,
                               HasProbabilitiesCol):
     n_classes = Param("n_classes", "number of classes", 2)
+    sparse_trained = Param("sparse_trained",
+                           "model was fit on sparse pair columns", False)
 
     def __init__(self, **kw):
         super().__init__(**kw)
@@ -98,8 +166,8 @@ class LogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol,
         self._w, self._b = np.asarray(s["w"]), np.asarray(s["b"])
 
     def _transform(self, t: Table) -> Table:
-        x = np.asarray(t[self.features_col], np.float32)
-        logits = x @ self._w + self._b
+        logits = _score_linear(t, self.features_col, self._w, self._b,
+                               self.sparse_trained)
         if self.n_classes <= 2:
             p1 = 1.0 / (1.0 + np.exp(-logits[:, 0]))
             proba = np.stack([1 - p1, p1], axis=1)
@@ -118,6 +186,21 @@ class LinearRegression(_LinearBase):
         x, y, w = self._data(t)
         m = LinearRegressionModel(features_col=self.features_col,
                                   prediction_col=self.prediction_col)
+        sparse = isinstance(x, tuple)
+        if sparse and self.solver == "normal":
+            import warnings
+            warnings.warn(
+                "solver='normal' would materialize the dense gram at the "
+                "sparse logical width; using the gradient solver instead",
+                stacklevel=2)
+        if sparse or self.solver != "normal":
+            params = _fit_linear(x, y, w, self.max_iter, 1, "regression",
+                                 self.reg_param, self.learning_rate,
+                                 n_features=self._table_width(t, x))
+            m._w = np.asarray(params["w"])[:, 0]
+            m._b = np.float32(np.asarray(params["b"])[0])
+            m.set(sparse_trained=sparse)
+            return m
         if self.solver == "normal":
             xn = np.asarray(x, np.float64)
             yn = np.asarray(y, np.float64)
@@ -127,15 +210,13 @@ class LinearRegression(_LinearBase):
             A = xtw @ xa + self.reg_param * np.eye(xa.shape[1])
             beta = np.linalg.solve(A, xtw @ yn)
             m._w, m._b = beta[:-1].astype(np.float32), np.float32(beta[-1])
-        else:
-            params = _fit_linear(x, y, w, self.max_iter, 1, "regression",
-                                 self.reg_param, self.learning_rate)
-            m._w = np.asarray(params["w"])[:, 0]
-            m._b = np.float32(np.asarray(params["b"])[0])
         return m
 
 
 class LinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
+    sparse_trained = Param("sparse_trained",
+                           "model was fit on sparse pair columns", False)
+
     def __init__(self, **kw):
         super().__init__(**kw)
         self._w = self._b = None
@@ -147,6 +228,7 @@ class LinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
         self._w, self._b = np.asarray(s["w"]), np.float32(np.asarray(s["b"]))
 
     def _transform(self, t: Table) -> Table:
-        x = np.asarray(t[self.features_col], np.float32)
-        return t.with_column(self.prediction_col,
-                             (x @ self._w + self._b).astype(np.float64))
+        logits = _score_linear(t, self.features_col,
+                               self._w.reshape(-1, 1), self._b,
+                               self.sparse_trained)[:, 0]
+        return t.with_column(self.prediction_col, logits.astype(np.float64))

@@ -284,3 +284,72 @@ def test_train_classifier_stays_dense_at_high_num_features():
     m = TrainClassifier(num_features=1 << 15).fit(t)
     out = m.transform(t)
     assert "scored_labels" in out.columns
+
+
+def test_linear_models_consume_sparse_pairs():
+    """LogisticRegression/LinearRegression train directly on the sparse pair
+    convention (hashed 2^18 featurization without dense materialization)."""
+    from mmlspark_tpu.featurize.featurize import Featurize
+    rng = np.random.default_rng(7)
+    n = 4000
+    cities = np.array([f"c{i}" for i in rng.integers(0, 300, n)], dtype=object)
+    y = (np.array([hash(c) for c in cities]) % 2).astype(np.float32)
+    t = Table({"city": cities, "label": y})
+    ft = Featurize(num_features=1 << 18, max_onehot_cardinality=8,
+                   label_col="label").fit(t).transform(t)
+    assert "features_idx" in ft.columns  # sparse at 2^18
+
+    m = LogisticRegression(max_iter=400, learning_rate=0.3).fit(ft)
+    out = m.transform(ft)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.95, acc
+    # save/load keeps the sparse scoring path
+    m2 = roundtrip(m)
+    np.testing.assert_allclose(m2.transform(ft)["probabilities"],
+                               out["probabilities"], rtol=1e-6)
+
+    yr = y * 3.0 + 1.0
+    tr = Table({"city": cities, "label": yr})
+    ftr = Featurize(num_features=1 << 18, max_onehot_cardinality=8,
+                    label_col="label").fit(tr).transform(tr)
+    mr = LinearRegression(max_iter=400, learning_rate=0.3).fit(ftr)
+    pred = mr.transform(ftr)["prediction"]
+    assert np.mean((pred - yr) ** 2) < 0.3
+
+
+def test_linear_sparse_uses_metadata_width_and_guards():
+    """Width comes from the featurizer's logical_width metadata (stable
+    regardless of which indices the training sample hit); dense-trained
+    models refuse sparse-pair scoring instead of remapping indices."""
+    from mmlspark_tpu.featurize.featurize import Featurize
+    rng = np.random.default_rng(9)
+    t = Table({"id": np.array([f"u{i}" for i in rng.integers(0, 50, 200)],
+                              dtype=object),
+               "label": rng.integers(0, 2, 200).astype(np.float32)})
+    fz = Featurize(num_features=1 << 18, max_onehot_cardinality=4,
+                   label_col="label").fit(t)
+    ft = fz.transform(t)
+    assert ft.column_meta("features_idx")["logical_width"] == \
+        fz.num_output_features
+    m = LogisticRegression(max_iter=50).fit(ft)
+    assert m._w.shape[0] == fz.num_output_features  # not max-index derived
+
+    # dense-trained model + sparse input -> clear error, not silent garbage
+    dense_t = Table({"features": rng.normal(size=(50, 4)).astype(np.float32),
+                     "label": rng.integers(0, 2, 50).astype(np.float32)})
+    dm = LogisticRegression(max_iter=20).fit(dense_t)
+    with pytest.raises(TypeError, match="dense"):
+        dm.transform(ft.drop("features") if "features" in ft else ft)
+
+
+def test_linear_regression_sparse_warns_on_normal_solver():
+    from mmlspark_tpu.featurize.featurize import Featurize
+    rng = np.random.default_rng(10)
+    t = Table({"id": np.array([f"u{i}" for i in rng.integers(0, 30, 100)],
+                              dtype=object),
+               "label": rng.normal(size=100).astype(np.float32)})
+    ft = Featurize(num_features=1 << 16, max_onehot_cardinality=4,
+                   label_col="label").fit(t).transform(t)
+    with pytest.warns(UserWarning, match="gradient solver"):
+        m = LinearRegression(solver="normal", max_iter=30).fit(ft)
+    assert m.sparse_trained is True
