@@ -4,7 +4,8 @@ from .resnet import ResNet, resnet18, resnet50
 from .image_featurizer import ImageFeaturizer
 from .transformer import (TransformerSentenceEncoder, init_transformer,
                           transformer_apply)
+from .lm_training import ShardedLMTrainer
 
 __all__ = ["DNNModel", "ResNet", "resnet18", "resnet50", "ImageFeaturizer",
            "TransformerSentenceEncoder", "init_transformer",
-           "transformer_apply"]
+           "transformer_apply", "ShardedLMTrainer"]

@@ -1,0 +1,53 @@
+"""Sharded LM training: one jitted dp x tp step over the virtual mesh
+(GSPMD layout — XLA inserts the dp grad all-reduce and tp collectives)."""
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.models.dnn.lm_training import ShardedLMTrainer
+from mmlspark_tpu.parallel import DATA_AXIS, MODEL_AXIS, grid_mesh
+
+
+def _toy_batch(rng, vocab, b, s):
+    # learnable structure: token t is followed by (t+1) % vocab
+    start = rng.integers(0, vocab, size=(b, 1))
+    ramp = (start + np.arange(s)) % vocab
+    return ramp.astype(np.int32)
+
+
+def test_dp_tp_train_step_learns():
+    mesh = grid_mesh((2, 4))  # dp=2, tp=4 on the 8 virtual devices
+    trainer = ShardedLMTrainer(vocab_size=50, mesh=mesh, d_model=64,
+                               n_heads=8, n_layers=2, d_ff=128, max_len=32,
+                               lr=3e-3, seed=0)
+    rng = np.random.default_rng(0)
+    first = None
+    for i in range(30):
+        loss = trainer.step(_toy_batch(rng, 50, 8, 16))
+        if first is None:
+            first = loss
+    assert np.isfinite(loss)
+    assert loss < first * 0.5, (first, loss)
+    # params actually live sharded over the model axis
+    w1 = trainer.params["layers"][0]["w1"]
+    assert len(w1.sharding.spec) and w1.sharding.spec[1] == MODEL_AXIS
+
+
+def test_matches_single_device_training():
+    """dp x tp sharded steps compute the same losses as a 1x1 mesh."""
+    rng = np.random.default_rng(1)
+    batches = [_toy_batch(rng, 30, 4, 12) for _ in range(5)]
+    losses = {}
+    for name, shape in (("sharded", (2, 4)), ("single", (1, 1))):
+        tr = ShardedLMTrainer(vocab_size=30, mesh=grid_mesh(shape),
+                              d_model=32, n_heads=4, n_layers=1, d_ff=64,
+                              max_len=16, lr=1e-3, seed=3)
+        losses[name] = [tr.step(b) for b in batches]
+    np.testing.assert_allclose(losses["sharded"], losses["single"],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_head_divisibility_validated():
+    with pytest.raises(ValueError, match="model axis"):
+        ShardedLMTrainer(vocab_size=10, mesh=grid_mesh((2, 4)), n_heads=6)
