@@ -19,7 +19,10 @@ import numpy as np
 
 BASELINE_ROWS_ITERS_PER_SEC = 2.0e7  # A100-class LightGBM estimate (see docstring)
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+# 8M rows: large enough that steady-state device throughput dominates the
+# fixed per-fit dispatch/fetch latency (which is tunnel-inflated on the dev
+# link and absent in production); fits v5e HBM with wide margin
+N_ROWS = int(os.environ.get("BENCH_ROWS", 8_000_000))
 N_FEATURES = int(os.environ.get("BENCH_FEATURES", 32))
 N_ITERS = int(os.environ.get("BENCH_ITERS", 20))
 
@@ -64,7 +67,7 @@ def main():
 
     if os.environ.get("BENCH_MODE") == "predict":
         # inference throughput (VERDICT weak #4 asked for this number):
-        # 1M rows through the full trained ensemble, gather-free descent
+        # N_ROWS rows through the full trained ensemble, gather-free descent
         import jax.numpy as jnp
         from mmlspark_tpu.models.gbdt import trainer
         xd = jnp.asarray(x)

@@ -291,6 +291,24 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
     return margin, v_margin, sf, sb, lv, gn, cv, metrics
 
 
+def _fetch_packed(parts):
+    """One D2H round-trip for all chunk outputs: concat each of the five
+    (T, max_nodes) stacks across chunks on device, bitcast the integer ones
+    to f32, stack into a single (5, T, max_nodes) array and fetch it whole.
+    Per-array fetches each pay a full transfer round-trip, which dominates
+    wall time on high-latency device links."""
+    cat = [parts[0][i] if len(parts) == 1
+           else jnp.concatenate([p[i] for p in parts]) for i in range(5)]
+    packed = jnp.stack([
+        jax.lax.bitcast_convert_type(cat[0].astype(jnp.int32), jnp.float32),
+        jax.lax.bitcast_convert_type(cat[1].astype(jnp.int32), jnp.float32),
+        cat[2].astype(jnp.float32), cat[3].astype(jnp.float32),
+        cat[4].astype(jnp.float32)])
+    host = np.asarray(packed)
+    return (host[0].view(np.int32), host[1].view(np.int32),
+            host[2], host[3], host[4])
+
+
 def _build_booster(sf, sb, lv, tree_classes, mapper, p: BoostParams,
                    k_out: int, n_features: int, best_iter: int,
                    init_booster, base, gain=None, cover=None):
@@ -473,9 +491,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             if checkpoint_fn is not None:
                 # chunk boundary = natural checkpoint step: build the
                 # booster-so-far from the accumulated parts (host-cheap)
-                _sf, _sb, _lv, _gn, _cv = (
-                    np.concatenate([np.asarray(part[i]) for part in parts])
-                    for i in range(5))
+                _sf, _sb, _lv, _gn, _cv = _fetch_packed(parts)
                 _tc = np.tile(np.arange(k_out, dtype=np.int32),
                               _sf.shape[0] // max(k_out, 1))
                 checkpoint_fn(it + clen, _build_booster(
@@ -499,9 +515,11 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             it += clen
             if stop_at is not None:
                 break
-        sf, sb, lv, gn, cv = (np.concatenate([np.asarray(part[i])
-                                              for part in parts])
-                              for i in range(5))
+        # ONE D2H for every chunk's outputs: per-array fetches each pay a
+        # full transfer round-trip (5 serial fetches measured ~0.5s over a
+        # tunneled link), so pack the five (T, max_nodes) arrays into a
+        # single f32 device array (bitcasting the i32 ones) and fetch once.
+        sf, sb, lv, gn, cv = _fetch_packed(parts)
         if stop_at is not None:  # drop trees grown past the stopping point
             keep = stop_at * k_out
             sf, sb, lv = sf[:keep], sb[:keep], lv[:keep]
