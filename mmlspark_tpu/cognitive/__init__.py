@@ -1,12 +1,18 @@
 """Cognitive-service clients (reference: cognitive/ — SURVEY.md §2.8)."""
 from .base import CognitiveServiceBase
+from .face import FindSimilarFace, GroupFaces, IdentifyFaces, VerifyFaces
+from .search import AddDocuments, build_index_json, write_to_azure_search
 from .services import (AnalyzeImage, BingImageSearch, DescribeImage,
                        DetectEntireSeriesAnomalies, DetectFace,
                        DetectLastAnomaly, OCR)
+from .speech import SpeechToText, SpeechToTextStream
 from .text_analytics import (EntityDetector, KeyPhraseExtractor,
                              LanguageDetector, NER, TextSentiment)
 
-__all__ = ["AnalyzeImage", "BingImageSearch", "CognitiveServiceBase",
-           "DescribeImage", "DetectEntireSeriesAnomalies", "DetectFace",
-           "DetectLastAnomaly", "EntityDetector", "KeyPhraseExtractor",
-           "LanguageDetector", "NER", "OCR", "TextSentiment"]
+__all__ = ["AddDocuments", "AnalyzeImage", "BingImageSearch",
+           "CognitiveServiceBase", "DescribeImage",
+           "DetectEntireSeriesAnomalies", "DetectFace", "DetectLastAnomaly",
+           "EntityDetector", "FindSimilarFace", "GroupFaces", "IdentifyFaces",
+           "KeyPhraseExtractor", "LanguageDetector", "NER", "OCR",
+           "SpeechToText", "SpeechToTextStream", "TextSentiment",
+           "VerifyFaces", "build_index_json", "write_to_azure_search"]

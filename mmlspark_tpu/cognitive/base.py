@@ -25,6 +25,18 @@ from ..io.http import (HTTPRequest, HTTPResponse, HTTPTransformer,
                        JSONOutputParser)
 
 
+def jsonable(v):
+    """numpy scalars/arrays and tuples -> JSON-encodable equivalents (column
+    values routinely arrive as ndarray elements of object columns)."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (list, tuple)):
+        return [jsonable(x) for x in v]
+    return v
+
+
 class HasServiceParams:
     """Mixin: resolve value-or-column service params
     (reference: HasServiceParams / VectorizableParam, CognitiveServiceBase.scala:44-120)."""
@@ -52,6 +64,10 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
     timeout = Param("timeout", "per-request timeout (s)", 60.0)
     retry_times = Param("retry_times", "advanced-handler retries", 3)
     backoff = Param("backoff", "advanced-handler initial backoff (s)", 0.05)
+
+    # statuses whose payload carries per-row results; services with
+    # partial-failure responses widen this (Azure Search 207 Multi-Status)
+    _ok_statuses: tuple = (200,)
 
     # -- request construction (per service) ---------------------------------
     def _build_requests(self, t: Table) -> list:
@@ -105,7 +121,7 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
         outputs: list = [None] * n_rows
         errors: list = [None] * n_rows
         for resp, (lo, hi) in zip(responses, spans):
-            if resp is None or resp.status != 200:
+            if resp is None or resp.status not in self._ok_statuses:
                 msg = (f"HTTP {resp.status}: {resp.error or resp.reason}"
                        if resp is not None else "no response")
                 for i in range(lo, hi):

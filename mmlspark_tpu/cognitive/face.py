@@ -1,0 +1,121 @@
+"""Face-service clients beyond detection: find-similar, group, identify,
+verify (reference: cognitive/Face.scala:96-320 — FindSimilarFace, GroupFaces,
+IdentifyFaces, VerifyFaces). Each builds the documented JSON body from
+value-or-column service params; transport/auth/retry live in
+CognitiveServiceBase."""
+from __future__ import annotations
+
+import json
+
+from ..core import Param, Table
+from ..core.params import one_of
+from .base import CognitiveServiceBase, jsonable
+
+
+class _FaceBodyService(CognitiveServiceBase):
+    """Face services POST a JSON body assembled from service params; each
+    subclass lists (param, wire_name) pairs in _body_fields."""
+    _body_fields: tuple = ()
+
+    def _build_requests(self, t: Table):
+        from ..io.http import HTTPRequest
+        keys = self._service_value(t, "subscription_key")
+        cols = {name: self._service_value(t, name)
+                for name, _ in self._body_fields}
+        reqs = []
+        for i in range(len(t)):
+            body = {}
+            for name, wire in self._body_fields:
+                v = cols[name][i]
+                if v is not None:
+                    body[wire] = jsonable(v)
+            reqs.append(HTTPRequest(url=self.url, method="POST",
+                                    headers=self._headers(keys[i]),
+                                    body=json.dumps(body).encode()))
+        return reqs
+
+    def _parse_response(self, payload, row_count: int):
+        return [payload]
+
+
+class FindSimilarFace(_FaceBodyService):
+    """POST .../findsimilars (reference: FindSimilarFace, Face.scala:96-184):
+    query faceId against faceIds / a (large) face list; response is the
+    candidate array [{faceId|persistedFaceId, confidence}]."""
+    face_id = Param("face_id", "query face id", None)
+    face_id_col = Param("face_id_col", "per-row query face id column", None)
+    face_ids = Param("face_ids", "candidate face-id array", None)
+    face_ids_col = Param("face_ids_col", "per-row candidate array column", None)
+    face_list_id = Param("face_list_id", "persisted face list id", None)
+    face_list_id_col = Param("face_list_id_col", "per-row list id column", None)
+    large_face_list_id = Param("large_face_list_id",
+                               "persisted large face list id", None)
+    large_face_list_id_col = Param("large_face_list_id_col",
+                                   "per-row large list id column", None)
+    max_num_of_candidates_returned = Param(
+        "max_num_of_candidates_returned", "candidate cap (1-1000)", 20)
+    mode = Param("mode", "matchPerson or matchFace", "matchPerson",
+                 validator=one_of("matchPerson", "matchFace"))
+
+    _body_fields = (("face_id", "faceId"), ("face_ids", "faceIds"),
+                    ("face_list_id", "faceListId"),
+                    ("large_face_list_id", "largeFaceListId"),
+                    ("max_num_of_candidates_returned",
+                     "maxNumOfCandidatesReturned"),
+                    ("mode", "mode"))
+
+
+class GroupFaces(_FaceBodyService):
+    """POST .../group (reference: GroupFaces, Face.scala:186-208): cluster a
+    face-id array; response {groups: [[ids...]], messyGroup: [ids...]}."""
+    face_ids = Param("face_ids", "face-id array to cluster", None)
+    face_ids_col = Param("face_ids_col", "per-row face-id array column", None)
+
+    _body_fields = (("face_ids", "faceIds"),)
+
+
+class IdentifyFaces(_FaceBodyService):
+    """POST .../identify (reference: IdentifyFaces, Face.scala:210-262):
+    match face ids against a person group; response per face
+    {faceId, candidates: [{personId, confidence}]}."""
+    face_ids = Param("face_ids", "face ids to identify (max 10)", None)
+    face_ids_col = Param("face_ids_col", "per-row face-id array column", None)
+    person_group_id = Param("person_group_id", "person group to search", None)
+    person_group_id_col = Param("person_group_id_col",
+                                "per-row person group column", None)
+    large_person_group_id = Param("large_person_group_id",
+                                  "large person group to search", None)
+    large_person_group_id_col = Param("large_person_group_id_col",
+                                      "per-row large group column", None)
+    max_num_of_candidates_returned = Param(
+        "max_num_of_candidates_returned", "candidate cap (1-100)", 10)
+    confidence_threshold = Param("confidence_threshold",
+                                 "custom identification threshold", None)
+
+    _body_fields = (("face_ids", "faceIds"),
+                    ("person_group_id", "personGroupId"),
+                    ("large_person_group_id", "largePersonGroupId"),
+                    ("max_num_of_candidates_returned",
+                     "maxNumOfCandidatesReturned"),
+                    ("confidence_threshold", "confidenceThreshold"))
+
+
+class VerifyFaces(_FaceBodyService):
+    """POST .../verify (reference: VerifyFaces, Face.scala:264-320): same
+    person? {isIdentical, confidence} — face-to-face or face-to-person."""
+    face_id1 = Param("face_id1", "first face id", None)
+    face_id1_col = Param("face_id1_col", "per-row first face id column", None)
+    face_id2 = Param("face_id2", "second face id", None)
+    face_id2_col = Param("face_id2_col", "per-row second face id column", None)
+    face_id = Param("face_id", "face id (face-to-person mode)", None)
+    face_id_col = Param("face_id_col", "per-row face id column", None)
+    person_id = Param("person_id", "person id (face-to-person mode)", None)
+    person_id_col = Param("person_id_col", "per-row person id column", None)
+    person_group_id = Param("person_group_id",
+                            "person group (face-to-person mode)", None)
+    person_group_id_col = Param("person_group_id_col",
+                                "per-row person group column", None)
+
+    _body_fields = (("face_id1", "faceId1"), ("face_id2", "faceId2"),
+                    ("face_id", "faceId"), ("person_id", "personId"),
+                    ("person_group_id", "personGroupId"))

@@ -1,0 +1,165 @@
+"""Azure Search sink (reference: cognitive/AzureSearch.scala — AddDocuments
+transformer + AzureSearchWriter.write(df)). `AddDocuments` pushes row batches
+to /indexes/<name>/docs/index with per-document @search.action rows and routes
+per-document errors; `write_to_azure_search` first creates the index from the
+table's schema (numpy dtype -> EDM type, reference sparkTypeToEdmType
+AzureSearch.scala:285-300) then streams the documents."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core import Param, Table
+from ..core.params import in_range
+from .base import CognitiveServiceBase, jsonable
+
+API_VERSION = "2017-11-11"  # reference: AzureSearchAPIConstants
+
+
+class AddDocuments(CognitiveServiceBase):
+    """Batched document push (reference: AddDocuments, AzureSearch.scala:86-144).
+    Each request body is {"value": [{"@search.action": ..., <fields>}, ...]};
+    the response's per-document statuses land in the error column."""
+    service_name = Param("service_name", "Azure Search service name", None)
+    index_name = Param("index_name", "target index", None)
+    action_col = Param("action_col",
+                       "column holding the per-row @search.action", None)
+    default_action = Param(
+        "default_action",
+        "action when action_col is unset: upload|merge|mergeOrUpload|delete",
+        "mergeOrUpload")
+    batch_size = Param("batch_size", "documents per request", 100,
+                       validator=in_range(1))
+
+    # Azure Search signals partial failure with 207 Multi-Status; the payload
+    # still carries per-document statuses, so route it, don't blanket-error it
+    _ok_statuses = (200, 207)
+
+    def _endpoint(self) -> str:
+        if self.url:
+            return self.url
+        return (f"https://{self.service_name}.search.windows.net/indexes/"
+                f"{self.index_name}/docs/index?api-version={API_VERSION}")
+
+    def _headers(self, key):
+        h = super()._headers(key)
+        if key:
+            h["api-key"] = key  # search auth header differs from Ocp-Apim
+        return h
+
+    def _doc_columns(self, t: Table):
+        skip = {self.action_col, self.error_col, self.output_col}
+        return [c for c in t.columns if c not in skip]
+
+    def _build_requests(self, t: Table):
+        from ..io.http import HTTPRequest
+        keys = self._service_value(t, "subscription_key")
+        cols = self._doc_columns(t)
+        actions = (t[self.action_col] if self.action_col
+                   else [self.default_action] * len(t))
+        data = {c: t[c] for c in cols}
+        reqs, self._spans = [], []
+        for lo, hi in self._batch_spans(t, keys):
+            docs = []
+            for i in range(lo, hi):
+                doc = {"@search.action": str(actions[i])}
+                for c in cols:
+                    doc[c] = jsonable(data[c][i])
+                docs.append(doc)
+            reqs.append(HTTPRequest(
+                url=self._endpoint(), method="POST",
+                headers=self._headers(keys[lo]),
+                body=json.dumps({"value": docs}).encode()))
+            self._spans.append((lo, hi))
+        return reqs
+
+    def _batch_spans(self, t: Table, keys):
+        """Every batch_size rows AND wherever the per-row key changes — a
+        request authenticates with ONE key (same invariant as
+        _TextAnalyticsBase._request_row_spans)."""
+        spans, lo = [], 0
+        for i in range(1, len(t) + 1):
+            if i == len(t) or i - lo >= int(self.batch_size) \
+                    or keys[i] != keys[lo]:
+                spans.append((lo, i))
+                lo = i
+        return spans
+
+    def _request_row_spans(self, t: Table):
+        return self._spans
+
+    def _parse_response(self, payload, row_count: int):
+        return [st.get("status") for st in payload.get("value", [])] or \
+            [None] * row_count
+
+    def _parse_errors(self, payload, row_count: int):
+        errs = []
+        for st in payload.get("value", []):
+            ok = st.get("status") in (True, 200, 201)
+            errs.append(None if ok else
+                        st.get("errorMessage") or f"status {st.get('status')}")
+        return errs or [None] * row_count
+
+
+_EDM_BY_KIND = {"f": "Edm.Double", "i": "Edm.Int64", "u": "Edm.Int64",
+                "b": "Edm.Boolean"}
+
+
+def _edm_type(col: np.ndarray) -> str:
+    """numpy column dtype -> EDM field type (reference sparkTypeToEdmType).
+    Object columns are typed from their first non-None element so a leading
+    null can't demote a list column to Edm.String."""
+    arr = np.asarray(col)
+    if arr.dtype.kind in _EDM_BY_KIND:
+        return _EDM_BY_KIND[arr.dtype.kind]
+    if arr.dtype.kind == "O":
+        first = next((v for v in arr if v is not None), None)
+        if isinstance(first, (list, tuple, np.ndarray)):
+            return "Collection(Edm.String)"
+    return "Edm.String"
+
+
+def build_index_json(t: Table, index_name: str, key_col: str,
+                     action_col: str = None, error_col: str = "errors") -> dict:
+    """Index definition from a Table's schema (reference dfToIndexJson,
+    AzureSearch.scala:193-204)."""
+    fields = []
+    for c in t.columns:
+        if c in (action_col, error_col):
+            continue
+        fields.append({"name": c, "type": _edm_type(t[c]),
+                       "searchable": _edm_type(t[c]) == "Edm.String",
+                       "filterable": True, "retrievable": True,
+                       "key": c == key_col})
+    return {"name": index_name, "fields": fields}
+
+
+def write_to_azure_search(t: Table, *, index_name: str, key_col: str,
+                          subscription_key: str, service_name: str = None,
+                          url: str = None, action_col: str = None,
+                          batch_size: int = 100) -> Table:
+    """Create-if-missing the index, then push every row (reference:
+    AzureSearchWriter.write / prepareDF, AzureSearch.scala:205-260). Returns
+    the table with per-document status/error columns appended."""
+    from ..io.http import HTTPRequest, advanced_handler
+    base = url or f"https://{service_name}.search.windows.net"
+    idx_req = HTTPRequest(
+        url=f"{base}/indexes/{index_name}?api-version={API_VERSION}",
+        method="PUT",
+        headers={"Content-Type": "application/json",
+                 "api-key": subscription_key},
+        body=json.dumps(build_index_json(t, index_name, key_col,
+                                         action_col)).encode())
+    resp = advanced_handler(idx_req)
+    if resp is None or resp.status not in (200, 201, 204):
+        raise RuntimeError(
+            "index creation failed: "
+            + (f"HTTP {resp.status} {resp.error or resp.reason}"
+               if resp is not None else "no response"))
+    adder = AddDocuments(index_name=index_name, action_col=action_col,
+                         subscription_key=subscription_key,
+                         batch_size=batch_size,
+                         url=f"{base}/indexes/{index_name}/docs/index"
+                             f"?api-version={API_VERSION}")
+    return adder.transform(t)

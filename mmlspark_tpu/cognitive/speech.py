@@ -1,0 +1,137 @@
+"""Speech-to-text clients (reference: cognitive/SpeechToText.scala — one-shot
+REST recognition of an audio column; cognitive/SpeechToTextSDK.scala:79-492 —
+streaming recognition that feeds audio in chunks and yields one row per
+recognized segment).
+
+The SDK variant's native push-stream has no TPU-side equivalent (it is
+network-bound, SURVEY §2.9 item 5), so `SpeechToTextStream` reproduces its
+*behavioral* contract — chunked upload, per-segment results, flattened output
+rows — over plain HTTP."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import Param, Table
+from ..core.params import HasInputCol, one_of
+from .base import CognitiveServiceBase
+
+
+def _audio_bytes(v) -> bytes:
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    return np.asarray(v, dtype=np.uint8).tobytes()
+
+
+class SpeechToText(CognitiveServiceBase, HasInputCol):
+    """One-shot recognition: POST raw audio bytes, response carries
+    RecognitionStatus/DisplayText (reference: SpeechToText.scala:25-95;
+    query params language/format/profanity mirror its ServiceParams)."""
+    input_col = Param("input_col", "audio-bytes column", "audio")
+    language = Param("language", "BCP-47 recognition language", "en-US")
+    language_col = Param("language_col", "per-row language column", None)
+    format = Param("format", "simple or detailed", "simple",
+                   validator=one_of("simple", "detailed"))
+    profanity = Param("profanity", "masked, removed, or raw", "masked",
+                      validator=one_of("masked", "removed", "raw"))
+    audio_content_type = Param(
+        "audio_content_type", "Content-Type of the audio payload",
+        "audio/wav; codecs=audio/pcm; samplerate=16000")
+
+    def _query(self, language: str) -> str:
+        import urllib.parse
+        return urllib.parse.urlencode({"language": language,
+                                       "format": self.format,
+                                       "profanity": self.profanity})
+
+    def _build_requests(self, t: Table):
+        from ..io.http import HTTPRequest
+        keys = self._service_value(t, "subscription_key")
+        langs = self._service_value(t, "language")
+        reqs = []
+        for i, audio in enumerate(t[self.input_col]):
+            headers = self._headers(keys[i])
+            headers["Content-Type"] = self.audio_content_type
+            reqs.append(HTTPRequest(
+                url=f"{self.url}?{self._query(langs[i])}", method="POST",
+                headers=headers, body=_audio_bytes(audio)))
+        return reqs
+
+    def _parse_response(self, payload, row_count: int):
+        return [payload]
+
+
+class SpeechToTextStream(SpeechToText):
+    """Streaming-shaped recognition (reference: SpeechToTextSDK.scala): the
+    audio column is split into fixed-size chunks, each chunk is recognized
+    independently (bounded-concurrency client), and the output value is the
+    ORDERED list of per-segment results — the same rows the SDK transformer
+    emits from its BlockingQueueIterator (:45). `flatten_output=True`
+    reproduces its one-row-per-segment output shape."""
+    chunk_bytes = Param("chunk_bytes", "audio bytes per recognized segment",
+                        1 << 20)
+    flatten_output = Param("flatten_output",
+                           "emit one row per segment instead of a list", False)
+
+    def _build_requests(self, t: Table):
+        from ..io.http import HTTPRequest
+        keys = self._service_value(t, "subscription_key")
+        langs = self._service_value(t, "language")
+        reqs, self._spans = [], []
+        for i, audio in enumerate(t[self.input_col]):
+            raw = _audio_bytes(audio)
+            size = max(int(self.chunk_bytes), 1)
+            n_chunks = max((len(raw) + size - 1) // size, 1)
+            for c in range(n_chunks):
+                headers = self._headers(keys[i])
+                headers["Content-Type"] = self.audio_content_type
+                reqs.append(HTTPRequest(
+                    url=f"{self.url}?{self._query(langs[i])}", method="POST",
+                    headers=headers, body=raw[c * size:(c + 1) * size]))
+            self._spans.append(n_chunks)
+        return reqs
+
+    def _transform(self, t: Table) -> Table:
+        out = super()._transform(t)
+        if not self.flatten_output:
+            return out
+        # one row per recognized segment (SDK contract): explode the
+        # segment lists, repeating the other columns
+        segs = out[self.output_col]
+        reps = np.asarray([max(len(s or []), 1) for s in segs])
+        exploded = {}
+        for name in out.columns:
+            col = out[name]
+            if name == self.output_col:
+                vals = []
+                for s in segs:
+                    vals.extend(s if s else [None])
+                arr = np.empty(len(vals), dtype=object)
+                arr[:] = vals
+                exploded[name] = arr
+            else:
+                exploded[name] = np.repeat(np.asarray(col), reps, axis=0)
+        return Table(exploded)
+
+    def _request_row_spans(self, t: Table):
+        # every chunk-request of row i maps back onto row i
+        per_req = []
+        for i, n_chunks in enumerate(self._spans):
+            per_req.extend([(i, i + 1)] * n_chunks)
+        return per_req
+
+    def _route(self, responses, spans, n_rows: int):
+        """Collect each row's per-chunk results into an ordered list."""
+        outputs: list = [[] for _ in range(n_rows)]
+        errors: list = [None] * n_rows
+        for resp, (lo, _hi) in zip(responses, spans):
+            if resp is None or resp.status != 200:
+                errors[lo] = (f"HTTP {resp.status}: {resp.error or resp.reason}"
+                              if resp is not None else "no response")
+                continue
+            try:
+                outputs[lo].append(resp.json())
+            except ValueError as e:
+                errors[lo] = f"bad JSON: {e}"
+        return outputs, errors

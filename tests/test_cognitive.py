@@ -11,10 +11,14 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu import Table
-from mmlspark_tpu.cognitive import (BingImageSearch,
+from mmlspark_tpu.cognitive import (AddDocuments, BingImageSearch,
                                     DetectEntireSeriesAnomalies,
-                                    DetectLastAnomaly, KeyPhraseExtractor,
-                                    LanguageDetector, OCR, TextSentiment)
+                                    DetectLastAnomaly, GroupFaces,
+                                    IdentifyFaces, KeyPhraseExtractor,
+                                    LanguageDetector, OCR, FindSimilarFace,
+                                    SpeechToText, SpeechToTextStream,
+                                    TextSentiment, VerifyFaces,
+                                    write_to_azure_search)
 from tests.fuzzing import fuzz_transformer
 
 FUZZ_COVERED = [
@@ -24,6 +28,9 @@ FUZZ_COVERED = [
     "TextSentiment", "LanguageDetector", "EntityDetector", "NER",
     "KeyPhraseExtractor", "DetectEntireSeriesAnomalies", "DetectLastAnomaly",
     "OCR", "AnalyzeImage", "DescribeImage", "DetectFace", "BingImageSearch",
+    # mock-server tested below; all share CognitiveServiceBase plumbing
+    "FindSimilarFace", "GroupFaces", "IdentifyFaces", "VerifyFaces",
+    "SpeechToText", "SpeechToTextStream", "AddDocuments",
 ]
 
 GOOD_KEY = "test-key-123"
@@ -31,10 +38,12 @@ GOOD_KEY = "test-key-123"
 
 class _AzureMock(BaseHTTPRequestHandler):
     throttle_remaining = 0
+    created_indexes: list = []
     lock = threading.Lock()
 
     def _key_ok(self):
-        return self.headers.get("Ocp-Apim-Subscription-Key") == GOOD_KEY
+        return GOOD_KEY in (self.headers.get("Ocp-Apim-Subscription-Key"),
+                            self.headers.get("api-key"))
 
     def _reply(self, code, payload):
         out = json.dumps(payload).encode()
@@ -57,8 +66,15 @@ class _AzureMock(BaseHTTPRequestHandler):
             return self._reply(401, {"error": {"code": "401",
                                               "message": "bad key"}})
         n = int(self.headers.get("Content-Length", 0))
-        body = json.loads(self.rfile.read(n) or b"{}")
+        raw = self.rfile.read(n)
         path = urllib.parse.urlparse(self.path).path
+        if "/speech/" in path:  # audio payload: not JSON
+            q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+            return self._reply(200, {
+                "RecognitionStatus": "Success",
+                "DisplayText": f"heard {len(raw)} bytes",
+                "Language": q.get("language", ["?"])[0]})
+        body = json.loads(raw or b"{}")
         if path.endswith("/sentiment"):
             docs, errs = [], []
             for d in body["documents"]:
@@ -95,6 +111,52 @@ class _AzureMock(BaseHTTPRequestHandler):
             return self._reply(200, {
                 "language": "en", "regions": [{"lines": [{"words": [
                     {"text": body.get("url", "")[-7:]}]}]}]})
+        if path.endswith("/verify"):
+            same = body.get("faceId1") == body.get("faceId2")
+            return self._reply(200, {"isIdentical": same,
+                                     "confidence": 0.95 if same else 0.05})
+        if path.endswith("/group"):
+            ids = body["faceIds"]
+            groups = [[i for i in ids if i.startswith("a")],
+                      [i for i in ids if not i.startswith("a")]]
+            return self._reply(200, {"groups": [g for g in groups if g],
+                                     "messyGroup": []})
+        if path.endswith("/identify"):
+            return self._reply(200, [
+                {"faceId": fid,
+                 "candidates": [{"personId": f"person-of-{fid}",
+                                 "confidence": 0.9}]}
+                for fid in body["faceIds"]])
+        if path.endswith("/findsimilars"):
+            return self._reply(200, [
+                {"faceId": fid, "confidence": 0.8}
+                for fid in body.get("faceIds", [])
+                if fid != body.get("faceId")][
+                    :body.get("maxNumOfCandidatesReturned", 20)])
+        if path.endswith("/docs/index"):
+            statuses = []
+            for doc in body["value"]:
+                bad = doc.get("id") == "reject-me"
+                statuses.append({"key": doc.get("id"),
+                                 "status": not bad,
+                                 "errorMessage": "rejected" if bad else None,
+                                 "statusCode": 422 if bad else 201})
+            # real Azure Search: 207 Multi-Status on partial failure
+            code = 207 if any(not s["status"] for s in statuses) else 200
+            return self._reply(code, {"value": statuses})
+        return self._reply(404, {"error": "unknown path"})
+
+    def do_PUT(self):
+        cls = _AzureMock
+        if not self._key_ok():
+            return self._reply(401, {"error": "bad key"})
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        path = urllib.parse.urlparse(self.path).path
+        if "/indexes/" in path:
+            with cls.lock:
+                cls.created_indexes.append(body)
+            return self._reply(201, {"name": body.get("name")})
         return self._reply(404, {"error": "unknown path"})
 
     def do_GET(self):
@@ -244,3 +306,135 @@ def test_per_document_errors_reach_error_col(server):
     assert out["s"][1] is None
     assert "empty document" in out["errors"][1]
     assert out["errors"][0] is None and out["errors"][2] is None
+
+
+# ------------------------------------------------------------ face suite
+def test_verify_faces(server):
+    t = Table({"f1": np.array(["abc", "abc"], dtype=object),
+               "f2": np.array(["abc", "xyz"], dtype=object)})
+    vf = VerifyFaces(url=f"{server}/face/v1.0/verify",
+                     subscription_key=GOOD_KEY, face_id1_col="f1",
+                     face_id2_col="f2", output_col="v")
+    out = vf.transform(t)
+    assert out["v"][0]["isIdentical"] is True
+    assert out["v"][1]["isIdentical"] is False
+
+
+def test_group_faces(server):
+    ids = np.empty(1, dtype=object)
+    ids[0] = ["a1", "a2", "b1"]
+    gf = GroupFaces(url=f"{server}/face/v1.0/group",
+                    subscription_key=GOOD_KEY, face_ids_col="ids",
+                    output_col="g")
+    out = gf.transform(Table({"ids": ids}))
+    assert out["g"][0]["groups"] == [["a1", "a2"], ["b1"]]
+
+
+def test_identify_and_find_similar(server):
+    ids = np.empty(1, dtype=object)
+    ids[0] = ["f1", "f2"]
+    idf = IdentifyFaces(url=f"{server}/face/v1.0/identify",
+                        subscription_key=GOOD_KEY, face_ids_col="ids",
+                        person_group_id="pg", output_col="who")
+    out = idf.transform(Table({"ids": ids}))
+    assert out["who"][0][0]["candidates"][0]["personId"] == "person-of-f1"
+
+    fs = FindSimilarFace(url=f"{server}/face/v1.0/findsimilars",
+                         subscription_key=GOOD_KEY, face_id="q",
+                         face_ids=("q", "c1", "c2"), output_col="sim",
+                         max_num_of_candidates_returned=1)
+    out = fs.transform(Table({"x": np.zeros(1)}))
+    assert out["sim"][0] == [{"faceId": "c1", "confidence": 0.8}]
+
+
+# ------------------------------------------------------------ speech
+def test_speech_to_text(server):
+    audio = np.empty(2, dtype=object)
+    audio[0] = b"\x00" * 100
+    audio[1] = np.arange(50, dtype=np.uint8)
+    st = SpeechToText(url=f"{server}/speech/recognition/conversation"
+                          f"/cognitiveservices/v1",
+                      subscription_key=GOOD_KEY, input_col="audio",
+                      output_col="text", language="fr-FR")
+    out = st.transform(Table({"audio": audio}))
+    assert out["text"][0]["DisplayText"] == "heard 100 bytes"
+    assert out["text"][1]["DisplayText"] == "heard 50 bytes"
+    assert out["text"][0]["Language"] == "fr-FR"
+
+
+def test_speech_stream_chunks_and_flatten(server):
+    audio = np.empty(1, dtype=object)
+    audio[0] = b"\x01" * 250
+    st = SpeechToTextStream(url=f"{server}/speech/recognition/conversation"
+                                f"/cognitiveservices/v1",
+                            subscription_key=GOOD_KEY, input_col="audio",
+                            output_col="segs", chunk_bytes=100)
+    out = st.transform(Table({"audio": audio}))
+    texts = [s["DisplayText"] for s in out["segs"][0]]
+    assert texts == ["heard 100 bytes", "heard 100 bytes", "heard 50 bytes"]
+
+    flat = SpeechToTextStream(url=f"{server}/speech/recognition/conversation"
+                                  f"/cognitiveservices/v1",
+                              subscription_key=GOOD_KEY, input_col="audio",
+                              output_col="segs", chunk_bytes=100,
+                              flatten_output=True).transform(
+        Table({"audio": audio}))
+    assert len(flat) == 3  # one row per recognized segment (SDK contract)
+    assert flat["segs"][2]["DisplayText"] == "heard 50 bytes"
+
+
+# ------------------------------------------------------------ azure search
+def test_azure_search_writer(server):
+    _AzureMock.created_indexes.clear()
+    t = Table({"id": np.array(["1", "reject-me", "3"], dtype=object),
+               "score": np.array([0.5, 0.2, 0.9]),
+               "tags": np.array([["a"], ["b"], ["c"]], dtype=object)})
+    out = write_to_azure_search(t, index_name="idx", key_col="id",
+                                subscription_key=GOOD_KEY, url=server,
+                                batch_size=2)
+    # index was created from the schema with the right key + EDM types
+    idx = _AzureMock.created_indexes[0]
+    fields = {f["name"]: f for f in idx["fields"]}
+    assert fields["id"]["key"] is True
+    assert fields["score"]["type"] == "Edm.Double"
+    assert fields["tags"]["type"] == "Collection(Edm.String)"
+    # per-document statuses & errors routed back to rows across batches
+    assert out["errors"][0] is None and out["errors"][2] is None
+    assert "rejected" in out["errors"][1]
+
+
+def test_add_documents_batches(server):
+    t = Table({"id": np.array([str(i) for i in range(7)], dtype=object)})
+    ad = AddDocuments(subscription_key=GOOD_KEY, batch_size=3,
+                      url=f"{server}/indexes/idx/docs/index")
+    out = ad.transform(t)
+    assert all(e is None for e in out["errors"])
+
+
+def test_add_documents_splits_batches_on_key_change(server):
+    t = Table({"id": np.array(["1", "2", "3"], dtype=object),
+               "keys": np.array(["wrong", GOOD_KEY, GOOD_KEY], dtype=object)})
+    ad = AddDocuments(subscription_key_col="keys", batch_size=100,
+                      retry_times=1,
+                      url=f"{server}/indexes/idx/docs/index")
+    out = ad.transform(t)
+    # row 1's bad key may not take rows 2-3 down with it
+    assert "401" in out["errors"][0]
+    assert out["errors"][1] is None and out["errors"][2] is None
+
+
+def test_edm_type_skips_leading_none():
+    from mmlspark_tpu.cognitive.search import _edm_type
+    col = np.empty(3, dtype=object)
+    col[0], col[1], col[2] = None, ["a"], ["b"]
+    assert _edm_type(col) == "Collection(Edm.String)"
+
+
+def test_group_faces_ndarray_ids(server):
+    ids = np.empty(1, dtype=object)
+    ids[0] = np.array(["a1", "b1"], dtype=object)  # ndarray, not list
+    gf = GroupFaces(url=f"{server}/face/v1.0/group",
+                    subscription_key=GOOD_KEY, face_ids_col="ids",
+                    output_col="g")
+    out = gf.transform(Table({"ids": ids}))
+    assert out["g"][0]["groups"] == [["a1"], ["b1"]]
