@@ -143,3 +143,15 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
     def _request_row_spans(self, t: Table):
         """Row range each request covers; default 1:1."""
         return [(i, i + 1) for i in range(len(t))]
+
+    def _key_batched_spans(self, t: Table, batch_size: int):
+        """Batch boundaries: every batch_size rows AND wherever the per-row
+        subscription key changes — a request authenticates with ONE key, so
+        rows with different keys may never share a batch."""
+        keys = self._service_value(t, "subscription_key")
+        spans, lo = [], 0
+        for i in range(1, len(t) + 1):
+            if i == len(t) or i - lo >= batch_size or keys[i] != keys[lo]:
+                spans.append((lo, i))
+                lo = i
+        return spans

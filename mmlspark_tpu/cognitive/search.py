@@ -49,7 +49,10 @@ class AddDocuments(CognitiveServiceBase):
         return h
 
     def _doc_columns(self, t: Table):
-        skip = {self.action_col, self.error_col, self.output_col}
+        # metadata columns never become document fields — notably the
+        # per-row key column, which must not leak credentials into the index
+        skip = {self.action_col, self.error_col, self.output_col,
+                self.get("subscription_key_col")}
         return [c for c in t.columns if c not in skip]
 
     def _build_requests(self, t: Table):
@@ -59,8 +62,8 @@ class AddDocuments(CognitiveServiceBase):
         actions = (t[self.action_col] if self.action_col
                    else [self.default_action] * len(t))
         data = {c: t[c] for c in cols}
-        reqs, self._spans = [], []
-        for lo, hi in self._batch_spans(t, keys):
+        reqs = []
+        for lo, hi in self._request_row_spans(t):
             docs = []
             for i in range(lo, hi):
                 doc = {"@search.action": str(actions[i])}
@@ -71,23 +74,10 @@ class AddDocuments(CognitiveServiceBase):
                 url=self._endpoint(), method="POST",
                 headers=self._headers(keys[lo]),
                 body=json.dumps({"value": docs}).encode()))
-            self._spans.append((lo, hi))
         return reqs
 
-    def _batch_spans(self, t: Table, keys):
-        """Every batch_size rows AND wherever the per-row key changes — a
-        request authenticates with ONE key (same invariant as
-        _TextAnalyticsBase._request_row_spans)."""
-        spans, lo = [], 0
-        for i in range(1, len(t) + 1):
-            if i == len(t) or i - lo >= int(self.batch_size) \
-                    or keys[i] != keys[lo]:
-                spans.append((lo, i))
-                lo = i
-        return spans
-
     def _request_row_spans(self, t: Table):
-        return self._spans
+        return self._key_batched_spans(t, int(self.batch_size))
 
     def _parse_response(self, payload, row_count: int):
         return [st.get("status") for st in payload.get("value", [])] or \
@@ -128,8 +118,9 @@ def build_index_json(t: Table, index_name: str, key_col: str,
     for c in t.columns:
         if c in (action_col, error_col):
             continue
-        fields.append({"name": c, "type": _edm_type(t[c]),
-                       "searchable": _edm_type(t[c]) == "Edm.String",
+        edm = _edm_type(t[c])
+        fields.append({"name": c, "type": edm,
+                       "searchable": edm == "Edm.String",
                        "filterable": True, "retrievable": True,
                        "key": c == key_col})
     return {"name": index_name, "fields": fields}

@@ -25,18 +25,7 @@ class _TextAnalyticsBase(CognitiveServiceBase, HasInputCol):
     _doc_field = "score"
 
     def _request_row_spans(self, t: Table):
-        """Batch boundaries: every batch_size rows AND wherever the per-row
-        subscription key changes — a request authenticates with ONE key, so
-        rows with different keys may never share a batch."""
-        n_rows = len(t)
-        keys = self._service_value(t, "subscription_key")
-        spans = []
-        lo = 0
-        for i in range(1, n_rows + 1):
-            if i == n_rows or i - lo >= self.batch_size or keys[i] != keys[lo]:
-                spans.append((lo, i))
-                lo = i
-        return spans
+        return self._key_batched_spans(t, int(self.batch_size))
 
     def _build_requests(self, t: Table):
         texts = t[self.input_col]

@@ -1,0 +1,126 @@
+"""Multi-host cluster bootstrap and process-local data placement.
+
+The reference sizes its worker rings driver-side (ClusterUtil.getNumTasksPerExecutor,
+core/utils/ClusterUtil.scala:13-150) and forms them with a ServerSocket
+rendezvous + port arithmetic (LightGBMUtils.scala:119-188,
+TrainUtils.scala:523-550). The TPU-native replacement (SURVEY §2.10) is
+`jax.distributed` for rendezvous, ICI/DCN collectives for the ring, and
+global `jax.Array` construction from per-process shards for data placement —
+no sockets, no ports, no driver thread.
+
+Typical multi-host flow:
+
+    from mmlspark_tpu.parallel import cluster
+    info = cluster.initialize_cluster()          # no-op on single host
+    lo, hi = cluster.process_row_range(n_total)  # which rows THIS host loads
+    local = load_my_rows(lo, hi)
+    mesh = data_mesh()                           # global mesh, all hosts
+    garr = cluster.global_array(mesh, local)     # global jax.Array
+    ... pjit/shard_map over the mesh as usual ...
+    cluster.barrier("trained")                   # gang-schedule boundary
+"""
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class ClusterInfo(NamedTuple):
+    """This process's coordinates in the job (reference analog: partition id
+    + task count from ClusterUtil)."""
+    process_id: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+
+def initialize_cluster(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> ClusterInfo:
+    """Join (or start) the jax.distributed job and report coordinates.
+
+    On TPU pods all three arguments auto-detect from the metadata server; on
+    CPU/GPU fleets pass them explicitly (reference analog: the driver
+    rendezvous that collects host:port from every task,
+    LightGBMUtils.scala:119-188 — here the coordinator does it for us).
+    Idempotent: calling on an already-initialized or single-process job is a
+    no-op, so library code can call it unconditionally.
+    """
+    # Decide multi-process from the ARGUMENTS/ENV alone — probing
+    # jax.process_count() first would initialize the XLA backend, after
+    # which jax.distributed.initialize always refuses to run.
+    multi = (coordinator_address is not None
+             or num_processes not in (None, 1)
+             or os.environ.get("JAX_COORDINATOR_ADDRESS")
+             or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    import jax
+    if multi:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except RuntimeError as e:
+            # idempotence only: a second call in the same process is fine;
+            # anything else (backend already up, rendezvous failure) must
+            # surface — a silent fallback would run N disconnected jobs
+            if "already initialized" not in str(e).lower():
+                raise
+    return ClusterInfo(process_id=jax.process_index(),
+                       process_count=jax.process_count(),
+                       local_device_count=jax.local_device_count(),
+                       global_device_count=jax.device_count())
+
+
+def process_row_range(n_rows: int, process_id: Optional[int] = None,
+                      process_count: Optional[int] = None):
+    """[lo, hi) slice of a global row space this process should load — the
+    contiguous-block analog of Spark's partition assignment. Remainder rows
+    go to the leading processes so sizes differ by at most 1."""
+    import jax
+    pid = jax.process_index() if process_id is None else process_id
+    n_proc = jax.process_count() if process_count is None else process_count
+    base, extra = divmod(n_rows, n_proc)
+    lo = pid * base + min(pid, extra)
+    return lo, lo + base + (1 if pid < extra else 0)
+
+
+def global_array(mesh, local_rows: np.ndarray, axis_name: str = None):
+    """Assemble a row-sharded global jax.Array from THIS process's rows.
+
+    Single-process: a plain device_put with the mesh's row sharding.
+    Multi-host: `jax.make_array_from_process_local_data` stitches each
+    host's block into one addressable-global array — the TPU-native
+    replacement for the reference's per-worker native dataset build
+    (TrainUtils.scala:33-186), with no cross-host copy at all.
+    """
+    import jax
+    from .mesh import DATA_AXIS, row_sharding
+    sharding = row_sharding(mesh, axis_name or DATA_AXIS,
+                            ndim=np.ndim(local_rows))
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_rows)
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (reference analog:
+    BarrierTaskContext.barrier() under useBarrierExecutionMode,
+    TrainUtils.scala:590-596). No-op single-process."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_leader(value: np.ndarray) -> np.ndarray:
+    """Every process returns process 0's value (reference analog: the driver
+    broadcasting the assembled ring string / model bytes). Host-level
+    broadcast over the device fabric; identity single-process."""
+    import jax
+    if jax.process_count() == 1:
+        return np.asarray(value)
+    from jax.experimental import multihost_utils
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(np.asarray(value)))

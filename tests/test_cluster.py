@@ -1,0 +1,42 @@
+"""Cluster-bootstrap helpers on the 8-device virtual mesh (multi-host
+behavior reduces to the single-process fast paths here; the block
+arithmetic is tested explicitly across fake process grids)."""
+import numpy as np
+
+from mmlspark_tpu.parallel import (barrier, broadcast_from_leader, data_mesh,
+                                   global_array, initialize_cluster,
+                                   process_row_range)
+
+
+def test_initialize_single_process_is_noop():
+    info = initialize_cluster()
+    assert info.process_id == 0 and info.process_count == 1
+    assert info.global_device_count >= 8  # virtual mesh from conftest
+
+
+def test_process_row_range_partitions_exactly():
+    n = 103
+    spans = [process_row_range(n, pid, 8) for pid in range(8)]
+    assert spans[0][0] == 0 and spans[-1][1] == n
+    # contiguous, non-overlapping, sizes differ by at most one
+    sizes = []
+    for (lo, hi), (lo2, _) in zip(spans, spans[1:]):
+        assert hi == lo2
+        sizes.append(hi - lo)
+    sizes.append(spans[-1][1] - spans[-1][0])
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_global_array_row_sharded():
+    mesh = data_mesh(8)
+    arr = np.arange(64, dtype=np.float32).reshape(16, 4)
+    g = global_array(mesh, arr)
+    assert g.shape == (16, 4)
+    np.testing.assert_array_equal(np.asarray(g), arr)
+    assert len(g.sharding.device_set) == 8
+
+
+def test_barrier_and_broadcast_single_process():
+    barrier("test")  # must not hang
+    out = broadcast_from_leader(np.array([1, 2, 3]))
+    np.testing.assert_array_equal(out, [1, 2, 3])

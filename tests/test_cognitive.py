@@ -438,3 +438,19 @@ def test_group_faces_ndarray_ids(server):
                     output_col="g")
     out = gf.transform(Table({"ids": ids}))
     assert out["g"][0]["groups"] == [["a1"], ["b1"]]
+
+
+def test_add_documents_excludes_key_column_from_docs(server):
+    captured = {}
+    class _Capture(AddDocuments):
+        def _build_requests(self, t):
+            reqs = super()._build_requests(t)
+            captured["bodies"] = [json.loads(r.body) for r in reqs]
+            return reqs
+    t = Table({"id": np.array(["1"], dtype=object),
+               "keys": np.array([GOOD_KEY], dtype=object)})
+    _Capture(subscription_key_col="keys",
+            url=f"{server}/indexes/idx/docs/index").transform(t)
+    doc = captured["bodies"][0]["value"][0]
+    assert "keys" not in doc  # the credential column never becomes a field
+    assert doc["id"] == "1"
