@@ -34,9 +34,25 @@ def assert_stages_equal(a, b, rtol: float = 1e-6, atol: float = 1e-8,
 
 
 def _assert_values_equal(va, vb, rtol, atol, path):
+    from .params import Params
     from .pipeline import PipelineStage
     if isinstance(va, PipelineStage):
         assert_stages_equal(va, vb, rtol, atol, path)
+    elif isinstance(va, Params):
+        # non-stage Params values (Evaluators, config bundles): structural
+        # comparison — same class, same explicitly-set params. Transient
+        # params are skipped, matching assert_stages_equal and the fact that
+        # serialization drops them on save.
+        assert type(va) is type(vb), f"{path}: {type(va)} != {type(vb)}"
+
+        def persisted(obj):
+            return {k for k in obj._paramMap
+                    if not (obj._param_registry.get(k)
+                            and obj._param_registry[k].transient)}
+        assert persisted(va) == persisted(vb), f"{path}: params set"
+        for k in persisted(va):
+            _assert_values_equal(va._paramMap[k], vb._paramMap[k], rtol,
+                                 atol, f"{path}.{k}")
     elif isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
         va, vb = np.asarray(va), np.asarray(vb)
         assert va.shape == vb.shape, f"{path}: shape {va.shape} != {vb.shape}"

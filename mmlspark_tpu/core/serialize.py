@@ -53,6 +53,16 @@ def _encode_value(value, slot: str, path: str, arrays: dict) -> dict:
             save_stage(v, os.path.join(path, "stages", f"{slot}_{i}"))
             refs.append(f"stages/{slot}_{i}")
         return {"kind": "stage_list", "refs": refs}
+    from .params import Params
+    if isinstance(value, Params):
+        # non-stage Params objects (Evaluators, config bundles): encode the
+        # class by qualified name + its explicitly-set params, recursively
+        return {"kind": "params_obj",
+                "class": f"{type(value).__module__}.{type(value).__name__}",
+                "params": {n: _encode_value(v, f"{slot}__{n}", path, arrays)
+                           for n, v in value._paramMap.items()
+                           if not (value._param_registry.get(n)
+                                   and value._param_registry[n].transient)}}
     if isinstance(value, np.ndarray):
         if value.dtype == object:
             # np.savez would pickle these and load (allow_pickle=False)
@@ -213,6 +223,12 @@ def _decode_value(spec: dict, path: str, arrays: dict):
         mod, _, cname = spec["class"].rpartition(".")
         cls = getattr(importlib.import_module(mod), cname)
         return cls._from_json(spec["value"])
+    if kind == "params_obj":
+        import importlib
+        mod, _, cname = spec["class"].rpartition(".")
+        cls = getattr(importlib.import_module(mod), cname)
+        return cls(**{n: _decode_value(v, path, arrays)
+                      for n, v in spec["params"].items()})
     if kind == "named_fn":
         return _resolve_named_fn(spec)
     if kind == "pickled_fn":

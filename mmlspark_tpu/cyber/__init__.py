@@ -1,11 +1,12 @@
 """CyberML (reference: mmlspark/cyber — SURVEY.md §2.8)."""
+from .dataset import DataFactory
 from .access_anomaly import (AccessAnomaly, AccessAnomalyModel,
                              ComplementAccessTransformer)
 from .features import (IdIndexer, IdIndexerModel, LinearScalarScaler,
                        LinearScalarScalerModel, StandardScalarScaler,
                        StandardScalarScalerModel)
 
-__all__ = ["AccessAnomaly", "AccessAnomalyModel",
+__all__ = ["AccessAnomaly", "AccessAnomalyModel", "DataFactory",
            "ComplementAccessTransformer", "IdIndexer", "IdIndexerModel",
            "LinearScalarScaler", "LinearScalarScalerModel",
            "StandardScalarScaler", "StandardScalarScalerModel"]

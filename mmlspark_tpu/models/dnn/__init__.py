@@ -5,7 +5,9 @@ from .image_featurizer import ImageFeaturizer
 from .transformer import (TransformerSentenceEncoder, init_transformer,
                           transformer_apply)
 from .lm_training import ShardedLMTrainer
+from .transfer import DeepTransferClassifier, DeepTransferModel
 
 __all__ = ["DNNModel", "ResNet", "resnet18", "resnet50", "ImageFeaturizer",
            "TransformerSentenceEncoder", "init_transformer",
-           "transformer_apply", "ShardedLMTrainer"]
+           "transformer_apply", "ShardedLMTrainer", "DeepTransferClassifier",
+           "DeepTransferModel"]

@@ -1,0 +1,250 @@
+"""Transfer-learning fine-tune estimator: the training-side complement of
+ImageFeaturizer.
+
+The reference productizes only CNTK *inference*; transfer learning is
+"featurize with a cut network, train a SparkML learner on the features"
+(image/ImageFeaturizer.scala:40-215, SURVEY §2.5 'CNTKLearner: training is
+not in-JVM'). The TPU build closes that gap natively: the same backbone that
+featurizes can be fine-tuned end to end with optax under jit — head-only
+(frozen backbone, the reference's recipe) or full fine-tune (every weight
+updates, impossible in the reference)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core import Estimator, Model, Param, Table
+from ...core.params import HasInputCol, HasLabelCol, in_range, one_of
+
+
+def _to_batch(col) -> np.ndarray:
+    arr = np.asarray(col)
+    if arr.dtype == object:
+        arr = np.stack([np.asarray(v) for v in arr])
+    return arr
+
+
+def _make_backbone(model_name: str, num_classes: int, dtype):
+    """Feature-cut zoo backbone — ONE constructor for fit and transform so
+    train/serve can never diverge."""
+    import jax.numpy as jnp
+    from . import resnet as zoo
+    maker = {"resnet18": zoo.resnet18, "resnet50": zoo.resnet50}[model_name]
+    return maker(num_classes=num_classes, dtype=jnp.dtype(dtype),
+                 cut="features")
+
+
+def _prep_images(stage, t: Table) -> np.ndarray:
+    """input column -> (n, H, W, 3) f32 scaled batch (shared by fit and
+    transform for the same reason)."""
+    from ...image.ops import ResizeImageTransformer
+    imgs = _to_batch(t[stage.input_col])
+    if imgs.shape[1:3] != (stage.image_height, stage.image_width):
+        rt = ResizeImageTransformer(input_col=stage.input_col,
+                                    output_col="__r",
+                                    height=stage.image_height,
+                                    width=stage.image_width)
+        imgs = _to_batch(rt.transform(t)["__r"])
+    return imgs.astype(np.float32) * stage.scale
+
+
+class DeepTransferClassifier(Estimator, HasInputCol, HasLabelCol):
+    """Fine-tune a zoo backbone (resnet18/resnet50) on labeled images.
+
+    mode="head": freeze the backbone, train a fresh linear head on pooled
+    features (the reference's transfer recipe, on device). mode="full":
+    update every weight (backbone at a reduced LR)."""
+    model_name = Param("model_name", "zoo backbone", "resnet18",
+                       validator=one_of("resnet18", "resnet50"))
+    num_classes = Param("num_classes", "output classes", 10,
+                        validator=in_range(2))
+    mode = Param("mode", "head (frozen backbone) or full fine-tune", "head",
+                 validator=one_of("head", "full"))
+    epochs = Param("epochs", "passes over the data", 5, validator=in_range(1))
+    batch_size = Param("batch_size", "minibatch rows", 32,
+                       validator=in_range(1))
+    learning_rate = Param("learning_rate", "head learning rate", 1e-2)
+    backbone_lr_scale = Param("backbone_lr_scale",
+                              "backbone LR = learning_rate * this (full "
+                              "mode)", 0.1)
+    image_height = Param("image_height", "resize target", 32)
+    image_width = Param("image_width", "resize target", 32)
+    scale = Param("scale", "pixel scaling", 1.0 / 255.0)
+    dtype = Param("dtype", "backbone compute dtype", "bfloat16")
+    seed = Param("seed", "init + shuffle seed", 0)
+    prediction_col = Param("prediction_col", "output label column",
+                           "prediction")
+    probabilities_col = Param("probabilities_col", "class probabilities",
+                              "probabilities")
+
+    def __init__(self, variables=None, **kw):
+        kw.setdefault("input_col", "image")
+        super().__init__(**kw)
+        self._variables = variables  # optional pretrained backbone weights
+
+    def _backbone(self):
+        import jax.numpy as jnp
+        from . import resnet as zoo
+        feat = _make_backbone(self.model_name, self.num_classes, self.dtype)
+        if self._variables is None:
+            maker = {"resnet18": zoo.resnet18, "resnet50": zoo.resnet50}[
+                self.model_name]
+            full = maker(num_classes=self.num_classes,
+                         dtype=jnp.dtype(self.dtype), cut="logits")
+            self._variables = zoo.init_resnet(
+                full, (self.image_height, self.image_width, 3), self.seed)
+        return feat
+
+    def _fit(self, t: Table) -> "DeepTransferModel":
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        feat_model = self._backbone()
+        x = _prep_images(self, t)
+        y = np.asarray(t[self.label_col]).astype(np.int32)
+        n, c = len(y), int(self.num_classes)
+        rng = np.random.default_rng(self.seed)
+
+        full = self.mode == "full"
+        backbone_params = self._variables
+        bs0 = int(self.batch_size)
+        if not full:
+            # frozen backbone: featurize every image ONCE (the reference's
+            # transfer recipe), then train the head on cached features —
+            # epochs never re-pay the backbone forward pass
+            feat_fn = jax.jit(lambda xb: feat_model.apply(backbone_params, xb))
+            x = np.concatenate(
+                [np.asarray(feat_fn(jnp.asarray(x[lo:lo + bs0])),
+                            np.float32)
+                 for lo in range(0, n, bs0)])
+            d = x.shape[-1]
+        else:
+            d = int(np.asarray(feat_model.apply(
+                self._variables, jnp.asarray(x[:1]))).shape[-1])
+        key = jax.random.PRNGKey(self.seed)
+        head = {"w": jax.random.normal(key, (d, c)) * (1.0 / np.sqrt(d)),
+                "b": jnp.zeros((c,))}
+
+        def loss_fn(trainable, xb, yb):
+            if full:
+                feats = feat_model.apply(trainable["backbone"], xb)
+                h = trainable["head"]
+            else:
+                feats, h = xb, trainable  # xb already IS the cached features
+            logits = feats.astype(jnp.float32) @ h["w"] + h["b"]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+
+        if full:
+            trainable = {"backbone": backbone_params, "head": head}
+            tx = optax.multi_transform(
+                {"backbone": optax.adam(self.learning_rate
+                                        * self.backbone_lr_scale),
+                 "head": optax.adam(self.learning_rate)},
+                {"backbone": "backbone", "head": "head"})
+        else:
+            trainable = head
+            tx = optax.adam(self.learning_rate)
+        opt_state = tx.init(trainable)
+
+        @jax.jit
+        def step(trainable, opt_state, xb, yb):
+            loss, grads = jax.value_and_grad(loss_fn)(trainable, xb, yb)
+            updates, opt_state = tx.update(grads, opt_state, trainable)
+            return optax.apply_updates(trainable, updates), opt_state, loss
+
+        bs = int(self.batch_size)
+        pad = (-n) % bs
+        losses = []
+        for _ in range(int(self.epochs)):
+            order = rng.permutation(n)
+            if pad:  # repeat leading rows so every batch is full-shape
+                order = np.concatenate([order, order[:pad]])
+            for lo in range(0, len(order), bs):
+                sel = order[lo:lo + bs]
+                trainable, opt_state, loss = step(
+                    trainable, opt_state, jnp.asarray(x[sel]),
+                    jnp.asarray(y[sel]))
+            losses.append(float(loss))
+
+        if full:
+            backbone_params = trainable["backbone"]
+            head = trainable["head"]
+        else:
+            head = trainable
+        m = DeepTransferModel(**{p: getattr(self, p) for p in (
+            "model_name", "num_classes", "input_col", "image_height",
+            "image_width", "scale", "dtype", "prediction_col",
+            "probabilities_col")})
+        m._variables = backbone_params
+        m._head = {"w": np.asarray(head["w"], np.float32),
+                   "b": np.asarray(head["b"], np.float32)}
+        m._losses = losses
+        return m
+
+
+class DeepTransferModel(Model, HasInputCol):
+    model_name = Param("model_name", "zoo backbone", "resnet18")
+    num_classes = Param("num_classes", "output classes", 10)
+    image_height = Param("image_height", "resize target", 32)
+    image_width = Param("image_width", "resize target", 32)
+    scale = Param("scale", "pixel scaling", 1.0 / 255.0)
+    dtype = Param("dtype", "backbone compute dtype", "bfloat16")
+    batch_size = Param("batch_size", "inference minibatch", 64)
+    prediction_col = Param("prediction_col", "output label column",
+                           "prediction")
+    probabilities_col = Param("probabilities_col", "class probabilities",
+                              "probabilities")
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._variables = None
+        self._head = None
+        self._losses = []
+
+    @property
+    def training_losses(self):
+        return list(self._losses)
+
+    def _get_state(self):
+        import jax
+        from .model import _treedef_to_str
+        leaves, _ = jax.tree_util.tree_flatten(self._variables)
+        state = {"treedef": _treedef_to_str(self._variables),
+                 "n_leaves": len(leaves),
+                 "head_w": self._head["w"], "head_b": self._head["b"],
+                 "losses": np.asarray(self._losses, np.float64)}
+        for i, leaf in enumerate(leaves):
+            state[f"leaf_{i}"] = np.asarray(leaf)
+        return state
+
+    def _set_state(self, s):
+        from .model import _treedef_from_str
+        n = int(np.asarray(s["n_leaves"]))
+        leaves = [np.asarray(s[f"leaf_{i}"]) for i in range(n)]
+        self._variables = _treedef_from_str(str(s["treedef"]), leaves)
+        self._head = {"w": np.asarray(s["head_w"]),
+                      "b": np.asarray(s["head_b"])}
+        self._losses = np.asarray(s["losses"]).tolist()
+
+    def _transform(self, t: Table) -> Table:
+        import jax
+        import jax.numpy as jnp
+        feat_model = _make_backbone(self.model_name, self.num_classes,
+                                    self.dtype)
+        x = _prep_images(self, t)
+        w, b = jnp.asarray(self._head["w"]), jnp.asarray(self._head["b"])
+
+        @jax.jit
+        def score(xb):
+            feats = feat_model.apply(self._variables, xb)
+            return jax.nn.softmax(feats.astype(jnp.float32) @ w + b, axis=-1)
+
+        bs = int(self.batch_size)
+        probs = np.concatenate([np.asarray(score(jnp.asarray(x[lo:lo + bs])))
+                                for lo in range(0, len(x), bs)])
+        return t.with_columns({
+            self.probabilities_col: probs,
+            self.prediction_col: probs.argmax(-1).astype(np.float32)})

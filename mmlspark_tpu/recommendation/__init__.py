@@ -1,9 +1,12 @@
 """Recommendation (reference: recommendation/ — SURVEY.md §2.8)."""
 from .ranking import (RankingAdapter, RankingAdapterModel, RankingEvaluator,
+                      RankingTrainValidationSplit,
+                      RankingTrainValidationSplitModel,
                       RecommendationIndexer, RecommendationIndexerModel,
                       ranking_metrics)
 from .sar import SAR, SARModel
 
 __all__ = ["SAR", "SARModel", "RankingAdapter", "RankingAdapterModel",
-           "RankingEvaluator", "RecommendationIndexer",
+           "RankingEvaluator", "RankingTrainValidationSplit",
+           "RankingTrainValidationSplitModel", "RecommendationIndexer",
            "RecommendationIndexerModel", "ranking_metrics"]

@@ -185,3 +185,108 @@ class RankingAdapterModel(Model, HasLabelCol):
             labels[i] = items[users == u]
         return Table({self.user_col: uniq, "prediction": preds,
                       self.label_col: labels})
+
+
+class RankingTrainValidationSplit(Estimator, HasLabelCol):
+    """Per-user stratified train/validation split + param-map sweep over a
+    recommender, scored with RankingEvaluator (reference:
+    recommendation/RankingTrainValidationSplit.scala:25-200 — stratified
+    splitDF, minRatingsU/I filters, thread-pool sweep, best model kept)."""
+    estimator = Param("estimator", "recommender to sweep", None)
+    param_maps = Param("param_maps", "list of {param: value} overrides", None)
+    evaluator = Param("evaluator", "RankingEvaluator (defaults to ndcgAt)",
+                      None)
+    train_ratio = Param("train_ratio", "per-user train fraction", 0.75)
+    user_col = Param("user_col", "user id column", "user")
+    item_col = Param("item_col", "item id column", "item")
+    min_ratings_u = Param("min_ratings_u",
+                          "drop users with fewer ratings", 1,
+                          validator=in_range(1))
+    min_ratings_i = Param("min_ratings_i",
+                          "drop items with fewer ratings", 1,
+                          validator=in_range(1))
+    parallelism = Param("parallelism", "concurrent candidate fits", 1,
+                        validator=in_range(1))
+    seed = Param("seed", "split shuffle seed", 0)
+
+    def _filter_ratings(self, t: Table) -> Table:
+        users = np.asarray(t[self.user_col])
+        items = np.asarray(t[self.item_col])
+        while True:  # filters interact: iterate to the fixpoint (each round
+            # either drops rows or terminates, so this is bounded by len(t))
+            u_vals, u_cnt = np.unique(users, return_counts=True)
+            i_vals, i_cnt = np.unique(items, return_counts=True)
+            keep_u = np.isin(users, u_vals[u_cnt >= self.min_ratings_u])
+            keep_i = np.isin(items, i_vals[i_cnt >= self.min_ratings_i])
+            keep = keep_u & keep_i
+            if keep.all():
+                return t
+            t = t.filter(keep)
+            users, items = users[keep], items[keep]
+
+    def _split(self, t: Table):
+        """Per-user stratified split: each user keeps ceil(ratio * n_u) rows
+        in train (never 0), the rest validate (reference splitDF)."""
+        users = np.asarray(t[self.user_col])
+        rng = np.random.default_rng(self.seed)
+        in_train = np.zeros(len(users), bool)
+        for u in np.unique(users):
+            rows = np.flatnonzero(users == u)
+            rng.shuffle(rows)
+            n_train = max(int(np.ceil(self.train_ratio * len(rows))), 1)
+            in_train[rows[:n_train]] = True
+        return t.filter(in_train), t.filter(~in_train)
+
+    def _fit(self, t: Table) -> "RankingTrainValidationSplitModel":
+        if self.estimator is None:
+            raise ValueError(
+                "RankingTrainValidationSplit: estimator param is not set")
+        ev = self.evaluator or RankingEvaluator()
+        train, valid = self._split(self._filter_ratings(t))
+        maps = list(self.param_maps or [{}])
+
+        def run(pm):
+            est = self.estimator.copy(pm)
+            adapter = RankingAdapter(recommender=est, k=ev.k,
+                                     user_col=self.user_col,
+                                     item_col=self.item_col,
+                                     label_col=self.label_col)
+            fitted = adapter.fit(train)
+            return fitted, ev.evaluate(fitted.transform(valid))
+
+        if self.parallelism > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+                results = list(pool.map(run, maps))
+        else:
+            results = [run(pm) for pm in maps]
+
+        metrics = np.asarray([m for _, m in results], np.float64)
+        larger = getattr(ev, "is_larger_better", True)
+        best = int(np.argmax(metrics if larger else -metrics))
+        model = RankingTrainValidationSplitModel(
+            **{p: getattr(self, p) for p in ("user_col", "item_col",
+                                             "label_col")})
+        model.set(best_adapter=results[best][0],
+                  validation_metrics=[float(m) for _, m in results],
+                  best_index=best)
+        return model
+
+
+class RankingTrainValidationSplitModel(Model, HasLabelCol):
+    """Best fitted adapter (a complex stage Param, so save/load round-trips
+    it like any nested model) + the sweep's validation metrics."""
+    user_col = Param("user_col", "user id column", "user")
+    item_col = Param("item_col", "item id column", "item")
+    best_adapter = Param("best_adapter", "best fitted RankingAdapterModel",
+                         None)
+    validation_metrics = Param("validation_metrics",
+                               "metric per swept param map", None)
+    best_index = Param("best_index", "winning param-map index", -1)
+
+    @property
+    def best_model(self):
+        return self.best_adapter.recommender_model
+
+    def _transform(self, t: Table) -> Table:
+        return self.best_adapter.transform(t)

@@ -106,3 +106,28 @@ def test_access_anomaly_scores_cross_access_higher(access_log):
                     "user": np.asarray(["stranger"], dtype=object),
                     "res": np.asarray(["res_0"], dtype=object)})
     assert model.transform(unseen)["anomaly_score"][0] == 0.0
+
+
+def test_data_factory_end_to_end():
+    """DataFactory (reference: cyber/dataset.py): AccessAnomaly trained on
+    clustered intra-department access must score cross-department access
+    higher than unseen intra-department access."""
+    from mmlspark_tpu.cyber import DataFactory
+
+    f = DataFactory(seed=7)
+    train = f.create_clustered_training_data(ratio=0.4)
+    intra = f.create_clustered_intra_test_data(train)
+    inter = f.create_clustered_inter_test_data()
+    assert len(train) and len(intra) and len(inter)
+    # training pairs never leak into the intra test set
+    seen = set(zip(train["user"].tolist(), train["res"].tolist()))
+    dept_pairs = [(u, r) for u, r in zip(intra["user"].tolist(),
+                                         intra["res"].tolist())
+                  if r != "ffa"]
+    assert all(p not in seen for p in dept_pairs)
+
+    model = AccessAnomaly(max_iter=10, rank=8,
+                          likelihood_col="likelihood").fit(train)
+    s_intra = model.transform(intra)["anomaly_score"]
+    s_inter = model.transform(inter)["anomaly_score"]
+    assert s_inter.mean() > s_intra.mean(), (s_intra.mean(), s_inter.mean())

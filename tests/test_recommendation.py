@@ -10,7 +10,8 @@ from mmlspark_tpu.recommendation import (SAR, RankingAdapter, RankingEvaluator,
 from tests.fuzzing import fuzz_estimator
 
 FUZZ_COVERED = ["SAR", "SARModel", "RankingAdapter", "RankingAdapterModel",
-                "RecommendationIndexer", "RecommendationIndexerModel"]
+                "RecommendationIndexer", "RecommendationIndexerModel",
+                "RankingTrainValidationSplit"]
 
 
 @pytest.fixture
@@ -147,3 +148,37 @@ def test_precision_at_k_divides_by_k():
     labels[0] = np.array([1, 2, 3])
     m = ranking_metrics(preds, labels, k=10)
     np.testing.assert_allclose(m["precisionAtk"], 0.3)  # 3 hits / k=10
+
+
+def test_ranking_train_validation_split(events):
+    """Per-user stratified sweep (reference:
+    RankingTrainValidationSplit.scala): picks the best param map by ranking
+    metric and survives save/load."""
+    from mmlspark_tpu.recommendation import (RankingEvaluator,
+                                             RankingTrainValidationSplit)
+
+    from tests.fuzzing import fuzz_estimator
+    tvs = RankingTrainValidationSplit(
+        estimator=SAR(user_col="user", item_col="item"),
+        param_maps=[{"similarity_function": "jaccard"},
+                    {"similarity_function": "lift"}],
+        evaluator=RankingEvaluator(k=3, metric_name="recallAtK"),
+        train_ratio=0.75, user_col="user", item_col="item",
+        label_col="label", seed=3)
+    model, out = fuzz_estimator(tvs, events)  # save/load leg included
+    assert len(model.validation_metrics) == 2
+    assert 0 <= model.best_index < 2
+    assert "prediction" in out.columns
+
+
+def test_ranking_tvs_split_is_per_user():
+    from mmlspark_tpu.recommendation import RankingTrainValidationSplit
+    t = Table({"user": np.repeat(np.arange(6), 8).astype(np.int64),
+               "item": np.tile(np.arange(8), 6).astype(np.int64),
+               "rating": np.ones(48, np.float32)})
+    tvs = RankingTrainValidationSplit(estimator=None, train_ratio=0.75,
+                                      user_col="user", item_col="item")
+    train, valid = tvs._split(t)
+    for u in range(6):  # every user appears in BOTH halves
+        assert (np.asarray(train["user"]) == u).sum() == 6
+        assert (np.asarray(valid["user"]) == u).sum() == 2
