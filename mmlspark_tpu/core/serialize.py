@@ -225,8 +225,19 @@ def _decode_value(spec: dict, path: str, arrays: dict):
         return cls._from_json(spec["value"])
     if kind == "params_obj":
         import importlib
+        from .params import Params
         mod, _, cname = spec["class"].rpartition(".")
+        if mod.split(".")[0] in _NAMED_FN_DENYLIST:
+            raise ValueError(
+                f"artifact names a Params class from module {mod!r}; "
+                f"refusing to resolve it")
         cls = getattr(importlib.import_module(mod), cname)
+        if not (isinstance(cls, type) and issubclass(cls, Params)):
+            # a tampered artifact naming e.g. subprocess.Popen must not get
+            # a constructor call with artifact-controlled kwargs
+            raise ValueError(
+                f"artifact params_obj class {spec['class']!r} is not a "
+                f"Params subclass; refusing to instantiate it")
         return cls(**{n: _decode_value(v, path, arrays)
                       for n, v in spec["params"].items()})
     if kind == "named_fn":

@@ -25,14 +25,14 @@ def _to_batch(col) -> np.ndarray:
     return arr
 
 
-def _make_backbone(model_name: str, num_classes: int, dtype):
-    """Feature-cut zoo backbone — ONE constructor for fit and transform so
-    train/serve can never diverge."""
+def _make_backbone(model_name: str, num_classes: int, dtype,
+                   cut: str = "features"):
+    """Zoo backbone — the ONE constructor (and zoo registry) for fit and
+    transform so train/serve can never diverge."""
     import jax.numpy as jnp
     from . import resnet as zoo
     maker = {"resnet18": zoo.resnet18, "resnet50": zoo.resnet50}[model_name]
-    return maker(num_classes=num_classes, dtype=jnp.dtype(dtype),
-                 cut="features")
+    return maker(num_classes=num_classes, dtype=jnp.dtype(dtype), cut=cut)
 
 
 def _prep_images(stage, t: Table) -> np.ndarray:
@@ -83,32 +83,33 @@ class DeepTransferClassifier(Estimator, HasInputCol, HasLabelCol):
         super().__init__(**kw)
         self._variables = variables  # optional pretrained backbone weights
 
-    def _backbone(self):
-        import jax.numpy as jnp
+    def _init_variables(self):
+        """User-supplied warm start, or a fresh seeded init — computed per
+        call, never cached on the estimator: a refit after set(model_name=)
+        (or a copy() in a sweep) must not reuse another architecture's
+        weights. Seeded init makes the result reproducible anyway."""
         from . import resnet as zoo
-        feat = _make_backbone(self.model_name, self.num_classes, self.dtype)
-        if self._variables is None:
-            maker = {"resnet18": zoo.resnet18, "resnet50": zoo.resnet50}[
-                self.model_name]
-            full = maker(num_classes=self.num_classes,
-                         dtype=jnp.dtype(self.dtype), cut="logits")
-            self._variables = zoo.init_resnet(
-                full, (self.image_height, self.image_width, 3), self.seed)
-        return feat
+        if self._variables is not None:
+            return self._variables
+        full = _make_backbone(self.model_name, self.num_classes, self.dtype,
+                              cut="logits")
+        return zoo.init_resnet(
+            full, (self.image_height, self.image_width, 3), self.seed)
 
     def _fit(self, t: Table) -> "DeepTransferModel":
         import jax
         import jax.numpy as jnp
         import optax
 
-        feat_model = self._backbone()
+        feat_model = _make_backbone(self.model_name, self.num_classes,
+                                    self.dtype)
         x = _prep_images(self, t)
         y = np.asarray(t[self.label_col]).astype(np.int32)
         n, c = len(y), int(self.num_classes)
         rng = np.random.default_rng(self.seed)
 
         full = self.mode == "full"
-        backbone_params = self._variables
+        backbone_params = self._init_variables()
         bs0 = int(self.batch_size)
         if not full:
             # frozen backbone: featurize every image ONCE (the reference's
@@ -122,7 +123,7 @@ class DeepTransferClassifier(Estimator, HasInputCol, HasLabelCol):
             d = x.shape[-1]
         else:
             d = int(np.asarray(feat_model.apply(
-                self._variables, jnp.asarray(x[:1]))).shape[-1])
+                backbone_params, jnp.asarray(x[:1]))).shape[-1])
         key = jax.random.PRNGKey(self.seed)
         head = {"w": jax.random.normal(key, (d, c)) * (1.0 / np.sqrt(d)),
                 "b": jnp.zeros((c,))}

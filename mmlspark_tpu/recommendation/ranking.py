@@ -241,7 +241,7 @@ class RankingTrainValidationSplit(Estimator, HasLabelCol):
         if self.estimator is None:
             raise ValueError(
                 "RankingTrainValidationSplit: estimator param is not set")
-        ev = self.evaluator or RankingEvaluator()
+        ev = self.evaluator or RankingEvaluator(label_col=self.label_col)
         train, valid = self._split(self._filter_ratings(t))
         maps = list(self.param_maps or [{}])
 

@@ -138,7 +138,10 @@ class SARModel(Model, _SARParams):
         scores = self._scores(user_ids)
         if remove_seen:
             scores = np.where(self._affinity[user_ids] > 0, -np.inf, scores)
-        vals, idx = jax.lax.top_k(jnp.asarray(scores), num_items)
+        # a catalog smaller than the requested k returns every item, like
+        # the reference's recommendForAllUsers on a tiny item set
+        vals, idx = jax.lax.top_k(jnp.asarray(scores),
+                                  min(num_items, scores.shape[-1]))
         return Table({self.user_col: user_ids,
                       "recommendations": np.asarray(idx),
                       "ratings": np.asarray(vals, np.float64)})

@@ -236,3 +236,19 @@ def test_column_metadata_lifecycle():
     assert b.categorical_levels("c") == ["a", "b", "c"]
     assert a.concat(b).categorical_levels("c") == ["a", "b", "c"]
     assert t.repartition(2).partition(0).categorical_levels("c") == ["a", "b", "c"]
+
+
+def test_params_obj_decode_rejects_non_params_class(tmp_path):
+    """A tampered artifact naming an arbitrary class (e.g. subprocess.Popen)
+    must not get a constructor call with artifact-controlled kwargs."""
+    import pytest
+    from mmlspark_tpu.core.serialize import _decode_value
+
+    with pytest.raises(ValueError, match="not a Params subclass"):
+        _decode_value({"kind": "params_obj", "class": "pathlib.Path",
+                       "params": {}}, str(tmp_path), {})
+    with pytest.raises(ValueError, match="refusing"):
+        _decode_value({"kind": "params_obj", "class": "subprocess.Popen",
+                       "params": {"args": {"kind": "json",
+                                           "value": ["true"]}}},
+                      str(tmp_path), {})

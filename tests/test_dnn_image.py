@@ -293,7 +293,8 @@ def test_deep_transfer_full_mode_updates_backbone():
     est = DeepTransferClassifier(model_name="resnet18", num_classes=2,
                                  mode="full", epochs=1, batch_size=8,
                                  image_height=16, image_width=16, seed=1)
-    before = jax.tree_util.tree_leaves(est._backbone() and est._variables)
+    # seeded init is reproducible, so a fresh call yields fit's start point
+    before = jax.tree_util.tree_leaves(est._init_variables())
     before = [np.asarray(l).copy() for l in before]
     model = est.fit(t)
     after = jax.tree_util.tree_leaves(model._variables)

@@ -182,3 +182,13 @@ def test_ranking_tvs_split_is_per_user():
     for u in range(6):  # every user appears in BOTH halves
         assert (np.asarray(train["user"]) == u).sum() == 6
         assert (np.asarray(valid["user"]) == u).sum() == 2
+
+
+def test_ranking_tvs_custom_label_col(events):
+    """Default evaluator must read the split's label_col, not 'label'."""
+    from mmlspark_tpu.recommendation import RankingTrainValidationSplit
+    tvs = RankingTrainValidationSplit(
+        estimator=SAR(user_col="user", item_col="item"),
+        user_col="user", item_col="item", label_col="truth", seed=1)
+    model = tvs.fit(events)
+    assert len(model.validation_metrics) == 1
