@@ -175,15 +175,30 @@ _NAMED_FN_DENYLIST = frozenset({
 })
 
 
-def _resolve_named_fn(spec: dict):
+def _import_artifact_module(mod: str, what: str):
+    """Shared guard for every artifact-controlled class/function lookup:
+    denylisted top-level packages never resolve, and modules OUTSIDE this
+    package must already be imported — an artifact must not be able to run
+    arbitrary top-level import side effects. (Legitimate user extensions
+    already require their defining module imported before load, exactly
+    like STAGE_REGISTRY lookup.)"""
     import importlib
-    import types
-    mod = spec["module"]
+    import sys
     if mod.split(".")[0] in _NAMED_FN_DENYLIST:
         raise ValueError(
-            f"artifact names a callable from module {mod!r}, which cannot "
-            f"hold UDFs; refusing to resolve it")
-    obj = importlib.import_module(mod)
+            f"artifact names a {what} from module {mod!r}, which cannot "
+            f"hold one; refusing to resolve it")
+    if mod.split(".")[0] != "mmlspark_tpu" and mod not in sys.modules:
+        raise ValueError(
+            f"artifact names a {what} from module {mod!r}, which is not "
+            f"imported; import the defining module before load()")
+    return importlib.import_module(mod)
+
+
+def _resolve_named_fn(spec: dict):
+    import types
+    mod = spec["module"]
+    obj = _import_artifact_module(mod, "callable")
     for part in spec["qualname"].split("."):
         obj = getattr(obj, part)
         if isinstance(obj, types.ModuleType):
@@ -219,19 +234,18 @@ def _decode_value(spec: dict, path: str, arrays: dict):
     if kind == "stage_list":
         return [load_stage(os.path.join(path, r)) for r in spec["refs"]]
     if kind == "custom":
-        import importlib
         mod, _, cname = spec["class"].rpartition(".")
-        cls = getattr(importlib.import_module(mod), cname)
+        cls = getattr(_import_artifact_module(mod, "codec class"), cname)
+        if not (isinstance(cls, type) and callable(
+                getattr(cls, "_from_json", None))):
+            raise ValueError(
+                f"artifact custom class {spec['class']!r} has no _from_json "
+                f"codec; refusing to use it")
         return cls._from_json(spec["value"])
     if kind == "params_obj":
-        import importlib
         from .params import Params
         mod, _, cname = spec["class"].rpartition(".")
-        if mod.split(".")[0] in _NAMED_FN_DENYLIST:
-            raise ValueError(
-                f"artifact names a Params class from module {mod!r}; "
-                f"refusing to resolve it")
-        cls = getattr(importlib.import_module(mod), cname)
+        cls = getattr(_import_artifact_module(mod, "Params class"), cname)
         if not (isinstance(cls, type) and issubclass(cls, Params)):
             # a tampered artifact naming e.g. subprocess.Popen must not get
             # a constructor call with artifact-controlled kwargs

@@ -5,8 +5,12 @@ from .http import (HTTPTransformer, SimpleHTTPTransformer, JSONInputParser,
                    CustomOutputParser, PartitionConsolidator, HTTPRequest,
                    HTTPResponse)
 from .serving import ServingServer, serve_pipeline, ServingQuery
+from .shared import (ForwardedPort, SharedVariable, forward_port_to_remote,
+                     shared_singleton)
 
 __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
            "JSONOutputParser", "StringOutputParser", "CustomInputParser",
            "CustomOutputParser", "PartitionConsolidator", "HTTPRequest",
-           "HTTPResponse", "ServingServer", "serve_pipeline", "ServingQuery"]
+           "HTTPResponse", "ServingServer", "serve_pipeline", "ServingQuery",
+           "SharedVariable", "shared_singleton", "ForwardedPort",
+           "forward_port_to_remote"]

@@ -238,3 +238,62 @@ def test_serving_epoch_commit_gc():
     finally:
         q.stop()
         server.stop()
+
+
+# ------------------------------------------------- shared vars / forwarding
+def test_shared_variable_singleton_per_name():
+    from mmlspark_tpu.io import SharedVariable, shared_singleton
+    import threading
+    calls = []
+
+    def make():
+        calls.append(1)
+        return object()
+
+    a = SharedVariable(make, name="t_shared_x")
+    outs = []
+    ts = [threading.Thread(target=lambda: outs.append(a.get))
+          for _ in range(8)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    assert len(calls) == 1 and all(o is outs[0] for o in outs)
+    # a second cell with the same name shares the instance (SharedSingleton)
+    assert shared_singleton("t_shared_x", make) is outs[0]
+    assert len(calls) == 1
+    # unnamed cells are independent
+    b = SharedVariable(make)
+    assert b.get is not outs[0] and len(calls) == 2
+
+
+def test_forward_port_walks_remote_ports():
+    from mmlspark_tpu.io import forward_port_to_remote
+
+    class FakeProc:
+        def poll(self): return None
+        def terminate(self): self.terminated = True
+        def wait(self, timeout=None): return 0
+
+    attempts = []
+
+    def fake_runner(user, host, ssh_port, bind, remote_port, lh, lp, key):
+        attempts.append(remote_port)
+        return FakeProc() if remote_port >= 9003 else None  # first 3 taken
+
+    fwd = forward_port_to_remote("u", "gateway", 8888, 9000,
+                                 _runner=fake_runner)
+    assert attempts == [9000, 9001, 9002, 9003]
+    assert fwd.remote_port == 9003 and fwd.local_port == 8888
+    fwd.stop()
+
+
+def test_forward_port_surfaces_real_ssh_errors():
+    """Auth/DNS failures must raise immediately with the real stderr, not
+    walk 50 ports reporting 'port unavailable'."""
+    import pytest
+    from mmlspark_tpu.io import forward_port_to_remote
+
+    def auth_fail_runner(*a, **kw):
+        raise RuntimeError("ssh tunnel to gw failed: Permission denied")
+
+    with pytest.raises(RuntimeError, match="Permission denied"):
+        forward_port_to_remote("u", "gw", 8888, 9000,
+                               _runner=auth_fail_runner)
