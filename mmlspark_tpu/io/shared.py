@@ -134,8 +134,8 @@ def _start_ssh(username, ssh_host, ssh_port, bind_address, remote_port,
             return None  # this remote port is taken -> walk to the next
         # auth/DNS/unreachable failures repeat identically on every port:
         # surface the real error instead of walking 50 ports
-        raise RuntimeError(f"ssh tunnel to {ssh_host} failed: {err or 'exit '
-                           + str(proc.returncode)}")
+        detail = err or f"exit {proc.returncode}"
+        raise RuntimeError(f"ssh tunnel to {ssh_host} failed: {detail}")
     except subprocess.TimeoutExpired:
         # still running -> tunnel established; drain stderr forever so a
         # chatty gateway can't fill the pipe and stall ssh mid-session

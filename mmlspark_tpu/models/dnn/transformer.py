@@ -71,8 +71,10 @@ def transformer_apply(params: dict, tokens, causal: bool = False,
                       attention: str = "dense", mesh=None, key_mask=None):
     """Encode (seq,) int32 tokens -> (seq, d_model) embeddings.
 
-    attention: 'dense' (single device), 'ring' or 'ulysses'
-    (sequence-parallel over `mesh` — seq must divide by the mesh axis).
+    attention: 'dense' (single device), 'flash' (single device, Pallas
+    online-softmax kernel — no (S, S) score matrix in HBM, the long-context
+    choice within one chip), 'ring' or 'ulysses' (sequence-parallel over
+    `mesh` — seq must divide by the mesh axis).
     key_mask: (seq,) bool excluding padding keys from attention (dense only;
     the sequence-parallel paths take exact-length documents).
     """
@@ -82,6 +84,11 @@ def transformer_apply(params: dict, tokens, causal: bool = False,
                                             ring_attention,
                                             ulysses_attention)
 
+    if key_mask is not None and attention != "dense":
+        raise ValueError(
+            f"key_mask is only supported with attention='dense'; "
+            f"attention={attention!r} would silently ignore it — trim "
+            f"padding instead")
     h = params["meta"]["n_heads"]
     d = params["meta"]["d_model"]
     dh = d // h
@@ -102,6 +109,9 @@ def transformer_apply(params: dict, tokens, causal: bool = False,
             a = ring_attention(q, k, v, mesh=mesh, causal=causal)
         elif attention == "ulysses":
             a = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+        elif attention == "flash":
+            from ...ops.flash_attention import flash_attention
+            a = flash_attention(q, k, v, causal=causal)
         else:
             a = reference_attention(q, k, v, causal=causal,
                                     key_mask=key_mask)
@@ -124,10 +134,12 @@ class TransformerSentenceEncoder(Model, HasInputCol, HasOutputCol):
     seed = Param("seed", "init seed", 0)
     attention = Param("attention",
                       "strategy for encode_long (single long documents): "
-                      "dense | ring | ulysses. Batch transform() always "
-                      "runs dense — short docs are vmapped, which composes "
-                      "with data sharding, not sequence sharding.", "dense",
-                      validator=one_of("dense", "ring", "ulysses"))
+                      "dense | flash (single-device Pallas, no (S,S) "
+                      "matrix) | ring | ulysses (sequence-parallel). Batch "
+                      "transform() always runs dense — short docs are "
+                      "vmapped, which composes with data sharding, not "
+                      "sequence sharding.", "dense",
+                      validator=one_of("dense", "flash", "ring", "ulysses"))
 
     def __init__(self, **kw):
         super().__init__(**kw)
@@ -234,7 +246,7 @@ class TransformerSentenceEncoder(Model, HasInputCol, HasOutputCol):
         'ring'/'ulysses' run sequence-parallel over `mesh`."""
         import jax
         import jax.numpy as jnp
-        if self.attention != "dense":
+        if self.attention in ("ring", "ulysses"):  # flash is single-device
             from ...parallel import data_mesh
             mesh = mesh or data_mesh()
             from ...parallel import DATA_AXIS

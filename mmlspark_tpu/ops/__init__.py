@@ -2,4 +2,13 @@ from .binning import BinMapper, fit_bins, apply_bins, bin_threshold_value
 from .histogram import node_feature_histograms
 
 __all__ = ["BinMapper", "fit_bins", "apply_bins", "bin_threshold_value",
-           "node_feature_histograms"]
+           "flash_attention", "node_feature_histograms"]
+
+
+def __getattr__(name):
+    # lazy: flash_attention pulls in pallas; binning/hashing consumers on
+    # CPU-only paths must not pay that import
+    if name == "flash_attention":
+        from .flash_attention import flash_attention
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,178 @@
+"""Pallas TPU flash attention: exact attention in O(block) VMEM.
+
+Within-chip complement of the cross-chip sequence parallelism in
+parallel/ring_attention.py (SURVEY.md §5 — long context is first-class in
+the TPU build; the reference has no attention ops at all). The ring handles
+sequences sharded ACROSS devices; this kernel handles a long block WITHIN a
+device without materializing the (S, S) score matrix in HBM:
+
+    grid = (heads, q_blocks, k_blocks), k innermost. Each (h, qb) cell
+    streams k-blocks through VMEM keeping the classic online-softmax
+    carry (running max m, denominator l, unnormalized accumulator acc) in
+    scratch; the normalized output is written once at the last k step.
+
+Causal masking compares global q/k positions, so it works for any block
+shape. Training: a custom VJP recomputes attention with the XLA reference
+path on the backward (O(S^2) memory there — flash backward is a later
+optimization), keeping forward inference/serving memory flat.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 256
+BLOCK_K = 256
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  n_k: int, block_q: int, block_k: int, seq_len: int,
+                  causal: bool, scale: float):
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: a k-block wholly above the diagonal contributes nothing —
+    # skip its matmuls entirely (halves causal compute; DMA still streams
+    # the block, which is bandwidth-trivial next to the MXU work)
+    visible = (not causal) or (kb * block_k <= qb * block_q + block_q - 1)
+
+    @pl.when(visible)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale      # (Bq, D)
+        k = k_ref[0].astype(jnp.float32)              # (Bk, D)
+        v = v_ref[0].astype(jnp.float32)              # (Bk, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_len                       # padded keys drop out
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, -1e30)
+
+        m_prev = m_ref[...]                           # (Bq, 1)
+        l_prev = l_ref[...]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)                        # (Bq, Bk)
+        alpha = jnp.exp(m_prev - m_new)               # rescale old carry
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = (acc_ref[...] * alpha
+                        + jax.lax.dot_general(
+                            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    @pl.when(kb == n_k - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    """(H, S, D) per-head layout in, (H, S, D) out."""
+    h, s, d = q.shape
+    sk = k.shape[1]
+    pad_q = (-s) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    n_q = (s + pad_q) // block_q
+    n_k = (sk + pad_k) // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, n_k=n_k, block_q=block_q, block_k=block_k,
+        seq_len=sk, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda hh, qb, kb: (hh, qb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, qb, kb: (hh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, qb, kb: (hh, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda hh, qb, kb: (hh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, s + pad_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :s]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_shd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _xla_reference_shd(q, k, v, causal, scale):
+    s = jnp.einsum("hqd,hkd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qp = jnp.arange(q.shape[1])[:, None]
+        kp = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where((qp >= kp)[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,hkd->hqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_fwd_vjp(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret), (q, k, v)
+
+
+def _flash_bwd_vjp(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_reference_shd(q_, k_, v_, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_shd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """Exact attention without the (S, S) HBM score matrix.
+
+    q: (S, H, D); k/v: (Sk, H, D). Returns (S, H, D), same dtype as q.
+    `interpret` defaults to True off-TPU so tests run anywhere.
+    """
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    qh = jnp.moveaxis(jnp.asarray(q), 1, 0)   # (H, S, D)
+    kh = jnp.moveaxis(jnp.asarray(k), 1, 0)
+    vh = jnp.moveaxis(jnp.asarray(v), 1, 0)
+    out = _flash_shd(qh, kh, vh, bool(causal), float(scale), int(block_q),
+                     int(block_k), bool(interpret))
+    return jnp.moveaxis(out, 0, 1)

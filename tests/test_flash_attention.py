@@ -1,0 +1,66 @@
+"""Pallas flash attention vs dense softmax attention (exactness) and
+gradient path. Runs in interpret mode on the CPU mesh."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mmlspark_tpu.ops.flash_attention import flash_attention
+from mmlspark_tpu.parallel.ring_attention import reference_attention
+
+
+def _rand(s, h, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(s, h, d)).astype(np.float32),
+            rng.normal(size=(s, h, d)).astype(np.float32),
+            rng.normal(size=(s, h, d)).astype(np.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_reference(causal):
+    q, k, v = _rand(384, 4, 64)   # not a block multiple: exercises padding
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_cross_attention_shapes():
+    q, _, _ = _rand(96, 2, 32, seed=1)
+    _, k, v = _rand(320, 2, 32, seed=2)
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert out.shape == (96, 2, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gradients_flow():
+    q, k, v = _rand(128, 2, 32)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True,
+                               block_q=64, block_k=64).sum()
+
+    def ref_loss(q, k, v):
+        return reference_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), causal=True).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fully_masked_rows_are_zero():
+    # cross attention where causal masks out EVERYTHING for early rows is
+    # impossible (row i always sees key i), so test via seq padding: keys
+    # shorter than a block; padded keys must contribute nothing
+    q, k, v = _rand(64, 1, 32, seed=3)
+    out = flash_attention(q, k[:40], v[:40], block_q=64, block_k=64)
+    ref = reference_attention(jnp.asarray(q), jnp.asarray(k[:40]),
+                              jnp.asarray(v[:40]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

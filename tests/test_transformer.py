@@ -96,3 +96,42 @@ def test_seq_exceeding_max_len_is_clear():
                          d_ff=32, max_len=8)
     with pytest.raises(ValueError, match="max_len"):
         transformer_apply(p, jnp.zeros(16, jnp.int32))
+
+
+def test_flash_attention_matches_dense_path():
+    from mmlspark_tpu.models.dnn.transformer import (init_transformer,
+                                                     transformer_apply)
+    p = init_transformer(vocab_size=50, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=96, seed=0)
+    toks = np.arange(96, dtype=np.int32) % 50
+    dense = np.asarray(transformer_apply(p, toks, attention="dense",
+                                         causal=True))
+    flash = np.asarray(transformer_apply(p, toks, attention="flash",
+                                         causal=True))
+    np.testing.assert_allclose(flash, dense, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_rejects_key_mask():
+    import pytest
+    from mmlspark_tpu.models.dnn.transformer import (init_transformer,
+                                                     transformer_apply)
+    p = init_transformer(vocab_size=10, d_model=32, n_heads=2, n_layers=1,
+                         d_ff=64, max_len=16, seed=0)
+    toks = np.zeros(16, np.int32)
+    with pytest.raises(ValueError, match="key_mask"):
+        transformer_apply(p, toks, attention="flash",
+                          key_mask=np.ones(16, bool))
+
+
+def test_encoder_encode_long_flash():
+    from mmlspark_tpu.models.dnn.transformer import TransformerSentenceEncoder
+    enc = TransformerSentenceEncoder(d_model=32, n_heads=2, n_layers=1,
+                                     d_ff=64, max_len=128, attention="flash")
+    toks = np.arange(100, dtype=np.int32) % 50  # no mesh, not divisible: ok
+    out = enc.encode_long(toks)
+    assert out.shape == (100, 32)
+    dense = TransformerSentenceEncoder(d_model=32, n_heads=2, n_layers=1,
+                                       d_ff=64, max_len=128,
+                                       attention="dense")
+    np.testing.assert_allclose(out, dense.encode_long(toks),
+                               rtol=2e-4, atol=2e-4)
