@@ -12,10 +12,11 @@ three statistics (grad, hess, count) ride one matmul by stacking them into
 the 3m columns.
 
 Layout honors TPU tiling (sublane x lane = 8 x 128): bins arrive transposed
-(F_pad, n) with F padded to a multiple of 8; each grid cell (fb, t) owns an
-(8 features x TILE rows) stripe and its (8, m, B) output block, accumulated
-across row tiles (init at t == 0). Row-aligned stats are (1, n) so the block
-(1, TILE) matches the full sublane dim.
+(F_pad, n) with F padded to a multiple of FEATURE_BLOCK; each grid cell
+(fb, t) owns a (FEATURE_BLOCK features x TILE_ROWS rows) stripe and its
+(FEATURE_BLOCK, m, B) output block, accumulated across row tiles (init at
+t == 0). Row-aligned stats are (1, n) so the block (1, TILE_ROWS) matches
+the full sublane dim.
 
 Valid for m = 2^level nodes up to M_MAX (VMEM-bounded 3m matmul columns);
 deeper levels fall back to the XLA scatter path (histogram.py routes).
@@ -34,8 +35,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE_ROWS = 4096
-FEATURE_BLOCK = 16
+# tile sweep on v5e (1M-4M rows x 32 features x 64 bins): 8192/32 is ~5%
+# faster than 4096/16; the VMEM worst case (m = M_MAX = 64 nodes with 256
+# bins: 3x(32,64,256) f32 outputs + (256,8192) bf16 bin one-hot +
+# (192,8192) bf16 stat rows) verified to compile and run on v5e
+TILE_ROWS = 8192
+FEATURE_BLOCK = 32
 M_MAX = 64  # max nodes per level handled here (VMEM bound on the 3m columns)
 
 
