@@ -171,3 +171,30 @@ def _treedef_from_str(s: str, leaves: list):
         return [build(v) for v in d["l"]]
 
     return build(json.loads(s))
+
+
+def tree_to_payload(tree, prefix: str, leaves_only: bool = False) -> dict:
+    """Flatten a param tree into numbered payload keys for the checkpoint /
+    state stores: {prefix}_{i} arrays + n_{prefix} count (+ treedef_{prefix}
+    unless leaves_only — optax NamedTuple nodes don't round-trip through
+    the treedef string, so optimizer states save leaves only)."""
+    import jax
+    import numpy as np
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    out = {f"n_{prefix}": len(leaves)}
+    if not leaves_only:
+        out[f"treedef_{prefix}"] = _treedef_to_str(tree)
+    for i, leaf in enumerate(leaves):
+        out[f"{prefix}_{i}"] = np.asarray(leaf)
+    return out
+
+
+def tree_from_payload(payload: dict, prefix: str, leaves_only: bool = False):
+    """Inverse of tree_to_payload: the rebuilt tree, or (leaves_only) the
+    flat leaf list for the caller to pour into a live structure."""
+    import numpy as np
+    n = int(np.asarray(payload[f"n_{prefix}"]))
+    leaves = [np.asarray(payload[f"{prefix}_{i}"]) for i in range(n)]
+    if leaves_only:
+        return leaves
+    return _treedef_from_str(str(payload[f"treedef_{prefix}"]), leaves)

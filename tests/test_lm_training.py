@@ -51,3 +51,42 @@ def test_matches_single_device_training():
 def test_head_divisibility_validated():
     with pytest.raises(ValueError, match="model axis"):
         ShardedLMTrainer(vocab_size=10, mesh=grid_mesh((2, 4)), n_heads=6)
+
+
+def test_lm_trainer_checkpoint_resume(tmp_path):
+    """Save at step 2, resume in a FRESH trainer, and the next step must
+    match the uninterrupted run exactly (SURVEY §5: step checkpointing is
+    the must-add the reference lacks)."""
+    from mmlspark_tpu.models.dnn.lm_training import ShardedLMTrainer
+    from mmlspark_tpu.parallel import grid_mesh
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, size=(8, 32)).astype(np.int32)
+    kw = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+              max_len=32, seed=0)
+    mesh = grid_mesh((2, 4))
+
+    a = ShardedLMTrainer(mesh=mesh, **kw)
+    a.step(toks); a.step(toks)
+    a.save_checkpoint(str(tmp_path), step=2)
+    loss_cont = a.step(toks)  # uninterrupted third step
+
+    b = ShardedLMTrainer(mesh=mesh, **kw)
+    b.step(toks)  # diverge b first so restore really matters
+    restored = b.restore_checkpoint(str(tmp_path))
+    assert restored == 2
+    loss_resumed = b.step(toks)
+    np.testing.assert_allclose(loss_resumed, loss_cont, rtol=1e-6)
+
+    # the crash-resume path: restore into a trainer that never stepped
+    # (its optax scalars are uncommitted fresh-init arrays)
+    c = ShardedLMTrainer(mesh=mesh, **kw)
+    assert c.restore_checkpoint(str(tmp_path)) == 2
+    np.testing.assert_allclose(c.step(toks), loss_cont, rtol=1e-6)
+
+    # config mismatch must refuse, not silently train a different model
+    import pytest
+    bad = ShardedLMTrainer(mesh=mesh, vocab_size=64, d_model=64, n_heads=4,
+                           n_layers=1, d_ff=64, max_len=32, seed=0)
+    with pytest.raises(ValueError, match="different model"):
+        bad.restore_checkpoint(str(tmp_path))
