@@ -32,8 +32,12 @@ BLOCK_K = 256
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  n_k: int, block_q: int, block_k: int, seq_len: int,
-                  causal: bool, scale: float):
+                  n_k: int, block_q: int, block_k: int, seq_end,
+                  causal: bool, scale: float, q_offset=0,
+                  k_offset=0, m_out_ref=None, l_out_ref=None,
+                  normalize: bool = True):
+    # q_offset/k_offset/seq_end may be static ints or traced SMEM scalars
+    # (ring attention's per-device offsets come from axis_index)
     qb = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -46,7 +50,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     # causal: a k-block wholly above the diagonal contributes nothing —
     # skip its matmuls entirely (halves causal compute; DMA still streams
     # the block, which is bandwidth-trivial next to the MXU work)
-    visible = (not causal) or (kb * block_k <= qb * block_q + block_q - 1)
+    visible = (not causal) or (k_offset + kb * block_k
+                               <= q_offset + qb * block_q + block_q - 1)
 
     @pl.when(visible)
     def _attend():
@@ -56,11 +61,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
 
-        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        q_pos = q_offset + qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        k_pos = k_offset + kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        valid = k_pos < seq_len                       # padded keys drop out
+        valid = k_pos < seq_end                       # padded keys drop out
         if causal:
             valid = valid & (q_pos >= k_pos)
         s = jnp.where(valid, s, -1e30)
@@ -81,13 +86,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kb == n_k - 1)
     def _finish():
-        o_ref[0] = (acc_ref[...]
-                    / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        if normalize:
+            o_ref[0] = (acc_ref[...]
+                        / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        else:  # stats mode: unnormalized accumulator + carry for merging
+            o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+        if m_out_ref is not None:
+            m_out_ref[0] = m_ref[...]
+            l_out_ref[0] = l_ref[...]
 
 
-def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
-                   block_k: int, interpret: bool):
-    """(H, S, D) per-head layout in, (H, S, D) out."""
+def _pad_blocks(q, k, v, block_q: int, block_k: int):
+    """Pad (H, S, D) operands up to block multiples; returns the padded
+    arrays + (s, sk, n_q, n_k). One implementation for both entry points so
+    padding/grid logic can never diverge."""
     h, s, d = q.shape
     sk = k.shape[1]
     pad_q = (-s) % block_q
@@ -97,12 +109,30 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
     if pad_k:
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
-    n_q = (s + pad_q) // block_q
-    n_k = (sk + pad_k) // block_k
+    return q, k, v, s, sk, (s + pad_q) // block_q, (sk + pad_k) // block_k
+
+
+_COMPILER_PARAMS = None
+
+
+def _compiler_params():
+    global _COMPILER_PARAMS
+    if _COMPILER_PARAMS is None:
+        _COMPILER_PARAMS = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return _COMPILER_PARAMS
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    """(H, S, D) per-head layout in, (H, S, D) out."""
+    d = q.shape[-1]
+    h = q.shape[0]
+    q, k, v, s, sk, n_q, n_k = _pad_blocks(q, k, v, block_q, block_k)
 
     kernel = functools.partial(
         _flash_kernel, n_k=n_k, block_q=block_q, block_k=block_k,
-        seq_len=sk, causal=causal, scale=scale)
+        seq_end=sk, causal=causal, scale=scale)
     out = pl.pallas_call(
         kernel,
         grid=(h, n_q, n_k),
@@ -113,15 +143,129 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda hh, qb, kb: (hh, qb, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, s + pad_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((h, q.shape[1], d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(q, k, v)
     return out[:, :s]
+
+
+def flash_attention_stats(q, k, v, q_offset, k_offset, causal: bool,
+                          scale: float, block_q: int = BLOCK_Q,
+                          block_k: int = BLOCK_K,
+                          interpret: Optional[bool] = None):
+    """Streaming-softmax PARTIAL attention for one K/V block: returns the
+    UNNORMALIZED accumulator plus the (m, l) carry, in the shapes ring
+    attention merges — acc (S, H, D) f32, m/l (H, S). q_offset/k_offset are
+    the blocks' global positions (causal masking across shards; traced
+    values welcome — they enter the kernel through SMEM). Differentiable:
+    the custom VJP recomputes the same contract densely in XLA on the
+    backward, like flash_attention. This is what lets ring attention run
+    flash WITHIN each device while `ppermute` rotates K/V ACROSS devices."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash_stats_vjp(q, k, v,
+                            jnp.asarray(q_offset, jnp.int32),
+                            jnp.asarray(k_offset, jnp.int32),
+                            bool(causal), float(scale), int(block_q),
+                            int(block_k), bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_stats_vjp(q, k, v, q_offset, k_offset, causal, scale, block_q,
+                     block_k, interpret):
+    return _flash_stats_forward(q, k, v, q_offset, k_offset, causal, scale,
+                                block_q, block_k, interpret)
+
+
+def _stats_xla_reference(q, k, v, q_offset, k_offset, causal, scale):
+    """Dense XLA implementation of the stats contract (backward pass)."""
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    q_pos = q_offset + jnp.arange(q.shape[0])
+    k_pos = k_offset + jnp.arange(k.shape[0])
+    if causal:
+        s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None], s, -1e30)
+    m = jnp.maximum(jnp.max(s, axis=-1), -1e30)             # (H, S)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _flash_stats_fwd(q, k, v, q_offset, k_offset, causal, scale, block_q,
+                     block_k, interpret):
+    out = _flash_stats_forward(q, k, v, q_offset, k_offset, causal, scale,
+                               block_q, block_k, interpret)
+    return out, (q, k, v, q_offset, k_offset)
+
+
+def _flash_stats_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    import jax.dtypes
+    q, k, v, q_offset, k_offset = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _stats_xla_reference(q_, k_, v_, q_offset,
+                                                k_offset, causal, scale),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    zero_int = np.zeros((), jax.dtypes.float0)
+    return dq, dk, dv, zero_int, zero_int
+
+
+_flash_stats_vjp.defvjp(_flash_stats_fwd, _flash_stats_bwd)
+
+
+def _flash_stats_forward(q, k, v, q_offset, k_offset, causal, scale,
+                         block_q, block_k, interpret):
+    qh = jnp.moveaxis(q, 1, 0)   # (H, S, D)
+    kh = jnp.moveaxis(k, 1, 0)
+    vh = jnp.moveaxis(v, 1, 0)
+    h, _, d = qh.shape
+    qh, kh, vh, s, sk, n_q, n_k = _pad_blocks(qh, kh, vh, block_q, block_k)
+
+    def kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, m_o, l_o,
+               acc_ref, m_ref, l_ref):
+        qoff = qoff_ref[0]
+        koff = koff_ref[0]
+        _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                      n_k=n_k, block_q=block_q, block_k=block_k,
+                      seq_end=koff + sk, causal=causal, scale=scale,
+                      q_offset=qoff, k_offset=koff,
+                      m_out_ref=m_o, l_out_ref=l_o, normalize=False)
+
+    qoff_arr = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff_arr = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid=(h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda hh, qb, kb: (hh, qb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, qb, kb: (hh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, qb, kb: (hh, kb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda hh, qb, kb: (hh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda hh, qb, kb: (hh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda hh, qb, kb: (hh, qb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, qh.shape[1], d), jnp.float32),
+            jax.ShapeDtypeStruct((h, qh.shape[1], 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, qh.shape[1], 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qoff_arr, koff_arr, qh, kh, vh)
+    # ring-merge shapes: acc (S, H, D), m/l (H, S)
+    return (jnp.moveaxis(acc[:, :s], 0, 1), m[:, :s, 0], l[:, :s, 0])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

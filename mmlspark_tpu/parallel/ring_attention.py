@@ -49,13 +49,15 @@ def _block_attend(q, k, v, mask):
 
 
 def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
-                            scale: float):
+                            scale: float, block_impl: str = "dense"):
     """Runs INSIDE shard_map: q/k/v are the local (block, H, D) shards."""
     n_dev = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     block = q.shape[0]
     h = q.shape[1]
-    q = q * scale
+    flash = block_impl == "flash"
+    if not flash:
+        q = q * scale  # flash scales inside its kernel
 
     def step(carry, i):
         k_blk, v_blk, acc, m_run, l_run = carry
@@ -63,13 +65,26 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
         # (my_idx + i) ... ppermute below shifts blocks DOWN the ring, so at
         # step i we hold the block originally owned by (my_idx + i) % n_dev
         src = (my_idx + i) % n_dev
-        if causal:
-            q_pos = my_idx * block + jnp.arange(block)
-            k_pos = src * block + jnp.arange(block)
-            mask = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
+        if flash:
+            # Pallas streaming kernel WITHIN the device: never materializes
+            # the (block, block) score matrix; offsets carry the global
+            # causal geometry across the ring. Kernel blocks shrink to the
+            # shard size (8-row tile granularity) so small shards don't pad
+            # up to the 256-row default and waste MXU work.
+            from ..ops.flash_attention import flash_attention_stats
+            bq = min(256, -(-block // 8) * 8)
+            o, m_blk, l_blk = flash_attention_stats(
+                q, k_blk, v_blk, my_idx * block, src * block, causal, scale,
+                block_q=bq, block_k=bq)
         else:
-            mask = jnp.zeros((block, block), q.dtype)
-        o, m_blk, l_blk = _block_attend(q, k_blk, v_blk, mask)
+            if causal:
+                q_pos = my_idx * block + jnp.arange(block)
+                k_pos = src * block + jnp.arange(block)
+                mask = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0,
+                                 -jnp.inf)
+            else:
+                mask = jnp.zeros((block, block), q.dtype)
+            o, m_blk, l_blk = _block_attend(q, k_blk, v_blk, mask)
         # streaming softmax merge (flash-attention accumulator)
         m_new = jnp.maximum(m_run, m_blk)
         alpha = jnp.exp(m_run - m_new)                      # rescale old
@@ -82,27 +97,36 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, acc, m_new, l_new), None
 
-    acc0 = jnp.zeros_like(q)
-    m0 = jnp.full((h, block), -1e30, q.dtype)  # finite: see _block_attend
-    l0 = jnp.zeros((h, block), q.dtype)
+    # f32 accumulators regardless of input dtype: the flash path's stats
+    # come back f32 (scan carry dtypes must match), and bf16 inputs keep
+    # f32 softmax accumulation either way
+    acc_dtype = jnp.float32 if flash else q.dtype
+    acc0 = jnp.zeros(q.shape, acc_dtype)
+    m0 = jnp.full((h, block), -1e30, acc_dtype)  # finite: see _block_attend
+    l0 = jnp.zeros((h, block), acc_dtype)
     (k, v, acc, m_run, l_run), _ = jax.lax.scan(
         step, (k, v, acc0, m0, l0), jnp.arange(n_dev))
-    return acc / jnp.maximum(l_run, 1e-30).T[:, :, None]
+    out = acc / jnp.maximum(l_run, 1e-30).T[:, :, None]
+    return out.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh=None, axis: str = DATA_AXIS,
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   block_impl: str = "dense"):
     """Exact attention over a sequence sharded across `mesh`'s `axis`.
 
     q/k/v: (seq, heads, dim) with seq divisible by the axis size. Returns
-    (seq, heads, dim) with the same sharding.
+    (seq, heads, dim) with the same sharding. block_impl="flash" runs the
+    Pallas streaming kernel inside each device (no per-device (block, block)
+    score matrix) — flash WITHIN a chip, ring ACROSS chips.
     """
     from . import data_mesh
     mesh = mesh or data_mesh()
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
     fn = functools.partial(_ring_attention_sharded, axis_name=axis,
-                           causal=causal, scale=scale)
+                           causal=causal, scale=scale,
+                           block_impl=block_impl)
     mapped = shard_map(fn, mesh=mesh,
                        in_specs=(P(axis), P(axis), P(axis)),
                        out_specs=P(axis), check_rep=False)
