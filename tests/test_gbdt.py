@@ -404,3 +404,50 @@ def test_deep_tree_predict_fallback():
     assert acc > 0.9
     leaves = booster.predict_leaf(x)
     assert leaves.shape == (400, 5)
+
+
+def test_init_score_continues_training(cancer):
+    """Training with init_score continues from another model's margins
+    (reference: batch training w/ init score,
+    VerifyLightGBMClassifier.scala:279-316): boosting on top of model A's
+    raw scores must beat A alone when A is undertrained."""
+    train, test = cancer
+    a = GBDTClassifier(num_iterations=5, num_tasks=1, seed=1).fit(train)
+    margins = np.asarray(a.booster.raw_score(
+        np.asarray(train["features"], np.float32),
+        init_score=a._init_score), np.float32)[:, 0]
+    t2 = train.with_column("prior", margins)
+    b = GBDTClassifier(num_iterations=30, init_score_col="prior",
+                       num_tasks=1, seed=1).fit(t2)
+
+    x_test = np.asarray(test["features"], np.float32)
+    m_test = np.asarray(a.booster.raw_score(
+        x_test, init_score=a._init_score))[:, 0]
+    b_test = np.asarray(b.booster.raw_score(x_test))[:, 0]
+    combined = 1 / (1 + np.exp(-(m_test + b_test)))
+    auc_a = auc(test["label"], np.asarray(
+        a.transform(test)["probabilities"])[:, 1])
+    auc_ab = auc(test["label"], combined)
+    assert auc_ab >= auc_a - 0.01, (auc_a, auc_ab)
+    assert auc_ab > 0.97, auc_ab
+
+
+def test_unbalanced_multiclass():
+    """Heavily skewed class sizes must not collapse to the majority class
+    (reference: unbalanced multiclass, VerifyLightGBMClassifier.scala:609)."""
+    rng = np.random.default_rng(3)
+    sizes = (600, 60, 20)
+    xs, ys = [], []
+    for c, n in enumerate(sizes):
+        xs.append(rng.normal(loc=3.0 * c, scale=1.0, size=(n, 6)))
+        ys.append(np.full(n, c))
+    x = np.concatenate(xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.float32)
+    t = Table({"features": x, "label": y})
+    m = GBDTClassifier(objective="multiclass", num_class=3,
+                       num_iterations=40, min_data_in_leaf=3,
+                       num_tasks=1).fit(t)
+    pred = np.asarray(m.transform(t)["prediction"])
+    for c, n in enumerate(sizes):  # every class (incl. the 20-row one) hit
+        recall = (pred[y == c] == c).mean()
+        assert recall > 0.9, (c, recall)
