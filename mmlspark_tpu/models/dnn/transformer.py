@@ -68,7 +68,8 @@ def _layer_norm(x, p):
 
 
 def transformer_apply(params: dict, tokens, causal: bool = False,
-                      attention: str = "dense", mesh=None, key_mask=None):
+                      attention: str = "dense", mesh=None, key_mask=None,
+                      attention_dtype=None):
     """Encode (seq,) int32 tokens -> (seq, d_model) embeddings.
 
     attention: 'dense' (single device), 'flash' (single device, Pallas
@@ -77,6 +78,10 @@ def transformer_apply(params: dict, tokens, causal: bool = False,
     `mesh` — seq must divide by the mesh axis).
     key_mask: (seq,) bool excluding padding keys from attention (dense only;
     the sequence-parallel paths take exact-length documents).
+    attention_dtype: cast q/k/v to this dtype for the attention op (e.g.
+    jnp.bfloat16 — the flash kernel runs bf16 operands ~1.4x faster on
+    v5e). Scores and softmax accumulation stay f32 on every path (dense,
+    flash, ring, ulysses); the output is cast back to the residual dtype.
     """
     import jax
     import jax.numpy as jnp
@@ -105,6 +110,10 @@ def transformer_apply(params: dict, tokens, causal: bool = False,
         q = (y @ lp["wq"]).reshape(seq, h, dh)
         k = (y @ lp["wk"]).reshape(seq, h, dh)
         v = (y @ lp["wv"]).reshape(seq, h, dh)
+        if attention_dtype is not None:
+            q = q.astype(attention_dtype)
+            k = k.astype(attention_dtype)
+            v = v.astype(attention_dtype)
         if attention == "ring":
             a = ring_attention(q, k, v, mesh=mesh, causal=causal)
         elif attention == "ulysses":
@@ -115,6 +124,7 @@ def transformer_apply(params: dict, tokens, causal: bool = False,
         else:
             a = reference_attention(q, k, v, causal=causal,
                                     key_mask=key_mask)
+        a = a.astype(x.dtype)
         x = x + a.reshape(seq, d) @ lp["wo"]
         y = _layer_norm(x, lp["ln2"])
         x = x + jax.nn.gelu(y @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
@@ -140,6 +150,12 @@ class TransformerSentenceEncoder(Model, HasInputCol, HasOutputCol):
                       "vmapped, which composes with data sharding, not "
                       "sequence sharding.", "dense",
                       validator=one_of("dense", "flash", "ring", "ulysses"))
+    attention_dtype = Param(
+        "attention_dtype",
+        "cast q/k/v to this dtype inside encode_long's attention "
+        "(bfloat16 runs the flash kernel ~1.4x faster on v5e; softmax "
+        "accumulation stays f32 on every path)", None,
+        validator=one_of(None, "bfloat16", "float32"))
 
     def __init__(self, **kw):
         super().__init__(**kw)
@@ -261,6 +277,8 @@ class TransformerSentenceEncoder(Model, HasInputCol, HasOutputCol):
         params = {k: (v if k == "meta"
                       else jax.tree_util.tree_map(jnp.asarray, v))
                   for k, v in raw.items()}
+        adt = jnp.dtype(self.attention_dtype) if self.attention_dtype \
+            else None
         return np.asarray(transformer_apply(
             params, jnp.asarray(tokens, jnp.int32),
-            attention=self.attention, mesh=mesh))
+            attention=self.attention, mesh=mesh, attention_dtype=adt))

@@ -36,16 +36,19 @@ from jax.sharding import PartitionSpec as P
 
 def _block_attend(q, k, v, mask):
     """Scores for one (q-block, kv-block) pair + streaming-softmax stats.
-    q (B, H, D), k/v (Bk, H, D), mask (B, Bk) additive."""
-    s = jnp.einsum("qhd,khd->hqk", q, k)                    # (H, B, Bk)
-    s = s + mask[None, :, :]
+    q (B, H, D), k/v (Bk, H, D), mask (B, Bk) additive. Softmax math and
+    outputs are f32 regardless of input dtype (bf16 inputs keep MXU speed;
+    an 8-bit-mantissa denominator would drift over long sequences)."""
+    s = jnp.einsum("qhd,khd->hqk", q, k,
+                   preferred_element_type=jnp.float32)      # (H, B, Bk)
+    s = s + mask.astype(jnp.float32)[None, :, :]
     # finite floor: a fully-masked block row has max -inf, and
     # exp(-inf - -inf) would be NaN — clamp so its probs are exactly 0
     m = jnp.maximum(jnp.max(s, axis=-1), -1e30)             # (H, B)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)                                 # (H, B)
-    o = jnp.einsum("hqk,khd->qhd", p, v)                    # (B, H, D)
-    return o, m, l
+    o = jnp.einsum("hqk,khd->qhd", p.astype(v.dtype), v)    # (B, H, D)
+    return o.astype(jnp.float32), m, l
 
 
 def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
@@ -97,13 +100,11 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, acc, m_new, l_new), None
 
-    # f32 accumulators regardless of input dtype: the flash path's stats
-    # come back f32 (scan carry dtypes must match), and bf16 inputs keep
-    # f32 softmax accumulation either way
-    acc_dtype = jnp.float32 if flash else q.dtype
-    acc0 = jnp.zeros(q.shape, acc_dtype)
-    m0 = jnp.full((h, block), -1e30, acc_dtype)  # finite: see _block_attend
-    l0 = jnp.zeros((h, block), acc_dtype)
+    # f32 accumulators regardless of input dtype: both block impls return
+    # f32 stats, and an 8-bit-mantissa streaming carry would drift
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((h, block), -1e30, jnp.float32)  # finite: _block_attend
+    l0 = jnp.zeros((h, block), jnp.float32)
     (k, v, acc, m_run, l_run), _ = jax.lax.scan(
         step, (k, v, acc0, m0, l0), jnp.arange(n_dev))
     out = acc / jnp.maximum(l_run, 1e-30).T[:, :, None]
@@ -156,7 +157,7 @@ def _ulysses_sharded(q, k, v, axis_name: str, causal: bool, scale: float,
     else:
         mask = jnp.zeros((seq, seq), q.dtype)
     o, _, l = _block_attend(qh * scale, kh, vh, mask)
-    o = o / jnp.maximum(l, 1e-30).T[:, :, None]             # (seq, H/n, D)
+    o = (o / jnp.maximum(l, 1e-30).T[:, :, None]).astype(q.dtype)
     # back: heads shard -> sequence shard. Splitting axis 0 sends block j to
     # device j; concatenating along the HEAD axis (2) reassembles the full
     # head dim in source (= global head group) order.
@@ -194,7 +195,10 @@ def reference_attention(q, k, v, causal: bool = False,
     from every query's softmax."""
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    s = jnp.einsum("qhd,khd->hqk", q * scale, k)
+    # scores/softmax in f32 even for bf16 inputs (matmuls still run at the
+    # input dtype's MXU rate via preferred_element_type); output cast back
+    s = jnp.einsum("qhd,khd->hqk", q * scale, k,
+                   preferred_element_type=jnp.float32)
     if causal:
         n = q.shape[0]
         mask = jnp.where(jnp.arange(n)[:, None] >= jnp.arange(n)[None, :],
@@ -204,4 +208,5 @@ def reference_attention(q, k, v, causal: bool = False,
         s = s + jnp.where(key_mask, 0.0, -jnp.inf)[None, None, :]
     p = jax.nn.softmax(s, axis=-1)
     # fully-masked rows (empty doc) softmax to NaN -> output 0
-    return jnp.einsum("hqk,khd->qhd", jnp.nan_to_num(p), v)
+    return jnp.einsum("hqk,khd->qhd", jnp.nan_to_num(p).astype(v.dtype),
+                      v).astype(q.dtype)

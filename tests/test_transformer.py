@@ -135,3 +135,30 @@ def test_encoder_encode_long_flash():
                                        attention="dense")
     np.testing.assert_allclose(out, dense.encode_long(toks),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_attention_dtype_bf16_close_to_f32():
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.dnn.transformer import (init_transformer,
+                                                     transformer_apply)
+    p = init_transformer(vocab_size=50, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=96, seed=0)
+    toks = np.arange(96, dtype=np.int32) % 50
+    f32 = np.asarray(transformer_apply(p, toks, attention="flash",
+                                       causal=True))
+    bf16 = np.asarray(transformer_apply(p, toks, attention="flash",
+                                        causal=True,
+                                        attention_dtype=jnp.bfloat16))
+    assert bf16.dtype == np.float32  # residual stream stays f32
+    np.testing.assert_allclose(bf16, f32, rtol=0.05, atol=0.05)
+
+
+def test_encoder_attention_dtype_param():
+    from mmlspark_tpu.models.dnn.transformer import TransformerSentenceEncoder
+    kw = dict(d_model=32, n_heads=2, n_layers=1, d_ff=64, max_len=64)
+    toks = np.arange(64, dtype=np.int32) % 50
+    f32 = TransformerSentenceEncoder(attention="flash", **kw).encode_long(toks)
+    bf = TransformerSentenceEncoder(attention="flash",
+                                    attention_dtype="bfloat16",
+                                    **kw).encode_long(toks)
+    np.testing.assert_allclose(bf, f32, rtol=0.05, atol=0.05)
