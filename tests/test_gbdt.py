@@ -451,3 +451,19 @@ def test_unbalanced_multiclass():
     for c, n in enumerate(sizes):  # every class (incl. the 20-row one) hit
         recall = (pred[y == c] == c).mean()
         assert recall > 0.9, (c, recall)
+
+
+def test_fit_is_deterministic(cancer):
+    """Two fits with the same seed must produce IDENTICAL trees — the
+    invariant checkpoint resume and the fuzzing serialization tests stand
+    on (SURVEY §7: determinism designed in, keys-in not ambient)."""
+    train, _ = cancer
+    kw = dict(num_iterations=15, bagging_fraction=0.7, bagging_freq=1,
+              feature_fraction=0.8, seed=11, num_tasks=1)
+    m1 = GBDTClassifier(**kw).fit(train)
+    m2 = GBDTClassifier(**kw).fit(train)
+    np.testing.assert_array_equal(m1.booster.split_feature,
+                                  m2.booster.split_feature)
+    np.testing.assert_array_equal(m1.booster.split_bin, m2.booster.split_bin)
+    np.testing.assert_array_equal(m1.booster.leaf_value,
+                                  m2.booster.leaf_value)
