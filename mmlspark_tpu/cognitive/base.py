@@ -135,6 +135,19 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
                 continue
             vals = self._parse_response(payload, hi - lo)
             errs = self._parse_errors(payload, hi - lo)
+            # a service answering with a different document count than the
+            # batch (e.g. a 207 body that dropped rows) must not silently
+            # leave rows at None via zip truncation — flag every row whose
+            # value the response failed to account for
+            if len(vals) != hi - lo or len(errs) != hi - lo:
+                msg = (f"response row-count mismatch: batch has {hi - lo} "
+                       f"rows but service returned {len(vals)} values / "
+                       f"{len(errs)} errors")
+                for off, i in enumerate(range(lo, hi)):
+                    outputs[i] = vals[off] if off < len(vals) else None
+                    errors[i] = (errs[off] if off < len(errs) and errs[off]
+                                 else msg)
+                continue
             for i, v, e in zip(range(lo, hi), vals, errs):
                 outputs[i] = v
                 errors[i] = e

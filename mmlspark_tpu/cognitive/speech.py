@@ -78,10 +78,10 @@ class SpeechToTextStream(SpeechToText):
         from ..io.http import HTTPRequest
         keys = self._service_value(t, "subscription_key")
         langs = self._service_value(t, "language")
-        reqs, self._spans = [], []
+        reqs = []
+        size = max(int(self.chunk_bytes), 1)
         for i, audio in enumerate(t[self.input_col]):
             raw = _audio_bytes(audio)
-            size = max(int(self.chunk_bytes), 1)
             n_chunks = max((len(raw) + size - 1) // size, 1)
             for c in range(n_chunks):
                 headers = self._headers(keys[i])
@@ -89,8 +89,15 @@ class SpeechToTextStream(SpeechToText):
                 reqs.append(HTTPRequest(
                     url=f"{self.url}?{self._query(langs[i])}", method="POST",
                     headers=headers, body=raw[c * size:(c + 1) * size]))
-            self._spans.append(n_chunks)
         return reqs
+
+    def _chunk_counts(self, t: Table):
+        # derived from the table every time rather than cached on the stage:
+        # a shared transformer instance may serve concurrent transform()
+        # calls, and mutable per-call state on self would race across them
+        size = max(int(self.chunk_bytes), 1)
+        return [max((len(_audio_bytes(a)) + size - 1) // size, 1)
+                for a in t[self.input_col]]
 
     def _transform(self, t: Table) -> Table:
         out = super()._transform(t)
@@ -117,7 +124,7 @@ class SpeechToTextStream(SpeechToText):
     def _request_row_spans(self, t: Table):
         # every chunk-request of row i maps back onto row i
         per_req = []
-        for i, n_chunks in enumerate(self._spans):
+        for i, n_chunks in enumerate(self._chunk_counts(t)):
             per_req.extend([(i, i + 1)] * n_chunks)
         return per_req
 

@@ -86,18 +86,25 @@ def forward_port_to_remote(username: str, ssh_host: str, local_port: int,
                            local_host: str = "127.0.0.1",
                            key_file: Optional[str] = None,
                            max_attempts: int = 50,
+                           settle_timeout: float = 1.5,
                            _runner=None) -> ForwardedPort:
     """Expose a local serving port on a remote gateway via `ssh -R`,
     walking remote ports upward until one binds (reference:
     PortForwarding.forwardPortToRemote's attempt loop). `_runner` injects a
-    fake ssh for tests."""
+    fake ssh for tests.
+
+    `settle_timeout` is how long ssh gets to REJECT the forward before we
+    declare the tunnel live; raise it for slow gateways. Even then the check
+    is a heuristic — long-running callers must watch
+    ``ForwardedPort.process.poll()`` for liveness."""
     runner = _runner or _start_ssh
     last_err = None
     for attempt in range(max_attempts):
         remote_port = remote_port_start + attempt
         try:
             proc = runner(username, ssh_host, ssh_port, bind_address,
-                          remote_port, local_host, local_port, key_file)
+                          remote_port, local_host, local_port, key_file,
+                          settle_timeout)
         except OSError as e:  # ssh binary missing etc.
             raise RuntimeError(f"could not launch ssh: {e}") from e
         if proc is not None:
@@ -112,7 +119,7 @@ _PORT_BUSY_MARKERS = ("remote port forwarding failed",
 
 
 def _start_ssh(username, ssh_host, ssh_port, bind_address, remote_port,
-               local_host, local_port, key_file):
+               local_host, local_port, key_file, settle_timeout=1.5):
     cmd = ["ssh", "-N", "-o", "ExitOnForwardFailure=yes",
            "-o", "BatchMode=yes", "-p", str(ssh_port),
            "-R", f"{bind_address}:{remote_port}:{local_host}:{local_port}",
@@ -123,25 +130,28 @@ def _start_ssh(username, ssh_host, ssh_port, bind_address, remote_port,
                             stderr=subprocess.PIPE)
     try:
         # ExitOnForwardFailure makes ssh exit promptly when the remote
-        # port is taken; give it a moment to fail. (Heuristic: a gateway
-        # slower than this to REJECT the forward is reported as bound;
-        # callers should treat ForwardedPort.process liveness as the
+        # port is taken; give it `settle_timeout` to fail. (Heuristic: a
+        # gateway slower than this to REJECT the forward is reported as
+        # bound; callers should treat ForwardedPort.process liveness as the
         # source of truth for long-running tunnels.)
-        proc.wait(timeout=1.5)
-        err = (proc.stderr.read() or b"").decode(errors="replace").strip()
-        proc.stderr.close()
-        if any(m in err.lower() for m in _PORT_BUSY_MARKERS):
-            return None  # this remote port is taken -> walk to the next
-        # auth/DNS/unreachable failures repeat identically on every port:
-        # surface the real error instead of walking 50 ports
-        detail = err or f"exit {proc.returncode}"
-        raise RuntimeError(f"ssh tunnel to {ssh_host} failed: {detail}")
+        proc.wait(timeout=settle_timeout)
     except subprocess.TimeoutExpired:
-        # still running -> tunnel established; drain stderr forever so a
-        # chatty gateway can't fill the pipe and stall ssh mid-session
-        threading.Thread(target=_drain, args=(proc.stderr,),
-                         daemon=True).start()
-        return proc
+        # one more poll after the wait: catches a rejection that landed in
+        # the narrow window between wait() raising and us returning
+        if proc.poll() is None:
+            # still running -> tunnel established; drain stderr forever so
+            # a chatty gateway can't fill the pipe and stall ssh mid-session
+            threading.Thread(target=_drain, args=(proc.stderr,),
+                             daemon=True).start()
+            return proc
+    err = (proc.stderr.read() or b"").decode(errors="replace").strip()
+    proc.stderr.close()
+    if any(m in err.lower() for m in _PORT_BUSY_MARKERS):
+        return None  # this remote port is taken -> walk to the next
+    # auth/DNS/unreachable failures repeat identically on every port:
+    # surface the real error instead of walking 50 ports
+    detail = err or f"exit {proc.returncode}"
+    raise RuntimeError(f"ssh tunnel to {ssh_host} failed: {detail}")
 
 
 def _drain(stream):

@@ -78,7 +78,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_prev = l_ref[...]
         m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
-        p = jnp.exp(s - m_new)                        # (Bq, Bk)
+        # mask p explicitly: when a row has seen NO valid key yet, m_new is
+        # still -1e30 and exp(s - m_new) would be 1 for masked entries,
+        # polluting acc/l for callers that normalize stats directly (the
+        # in-repo ring consumer is safe via the m==-1e30 merge weight, but
+        # flash_attention_stats is a public entry point)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # (Bq, Bk)
         alpha = jnp.exp(m_prev - m_new)               # rescale old carry
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = (acc_ref[...] * alpha

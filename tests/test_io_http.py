@@ -274,7 +274,8 @@ def test_forward_port_walks_remote_ports():
 
     attempts = []
 
-    def fake_runner(user, host, ssh_port, bind, remote_port, lh, lp, key):
+    def fake_runner(user, host, ssh_port, bind, remote_port, lh, lp, key,
+                    settle_timeout=1.5):
         attempts.append(remote_port)
         return FakeProc() if remote_port >= 9003 else None  # first 3 taken
 
