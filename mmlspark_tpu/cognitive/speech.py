@@ -24,6 +24,14 @@ def _audio_bytes(v) -> bytes:
     return np.asarray(v, dtype=np.uint8).tobytes()
 
 
+def _audio_len(v) -> int:
+    """Byte length without materializing the buffer (chunk-count derivation
+    runs once per transform on top of the request build's real conversion)."""
+    if isinstance(v, (bytes, bytearray)):
+        return len(v)
+    return np.asarray(v, dtype=np.uint8).size
+
+
 class SpeechToText(CognitiveServiceBase, HasInputCol):
     """One-shot recognition: POST raw audio bytes, response carries
     RecognitionStatus/DisplayText (reference: SpeechToText.scala:25-95;
@@ -96,7 +104,7 @@ class SpeechToTextStream(SpeechToText):
         # a shared transformer instance may serve concurrent transform()
         # calls, and mutable per-call state on self would race across them
         size = max(int(self.chunk_bytes), 1)
-        return [max((len(_audio_bytes(a)) + size - 1) // size, 1)
+        return [max((_audio_len(a) + size - 1) // size, 1)
                 for a in t[self.input_col]]
 
     def _transform(self, t: Table) -> Table:

@@ -33,10 +33,23 @@ class Booster(NamedTuple):
     best_iteration: int = -1    # early stopping; -1 = use all trees
     gain: Optional[np.ndarray] = None    # (T, max_nodes) f32 split gains
     cover: Optional[np.ndarray] = None   # (T, max_nodes) f32 node row counts
+    # native categorical splits: nodes flagged here route by membership of
+    # the (integer) raw value in the packed 16-bit category words instead of
+    # a threshold compare (reference: categoricalSlotIndexes semantics,
+    # lightgbm/params/LightGBMParams.scala:184-196)
+    split_is_cat: Optional[np.ndarray] = None  # (T, max_nodes) bool
+    cat_words: Optional[np.ndarray] = None     # (T, max_nodes, W16) i32
 
     @property
     def n_trees(self) -> int:
         return self.split_feature.shape[0]
+
+    def _cat_args(self, s):
+        """(split_is_cat, cat_words) slices for the predict kernels, or
+        (None, None) for purely numeric ensembles."""
+        if self.split_is_cat is None or self.cat_words is None:
+            return None, None
+        return self.split_is_cat[s], self.cat_words[s]
 
     def _used_trees(self):
         if self.best_iteration >= 0:
@@ -49,17 +62,21 @@ class Booster(NamedTuple):
     def raw_score(self, x, init_score: float = 0.0):
         """(n, F) f32 -> (n, n_classes) raw margins."""
         s = self._used_trees()
+        ic, cw = self._cat_args(s)
         out = trainer.predict_raw(
             np.asarray(x, dtype=np.float32),
             self.split_feature[s], self.threshold[s], self.leaf_value[s],
-            self.tree_class[s], self.max_depth, self.n_classes)
+            self.tree_class[s], self.max_depth, self.n_classes,
+            split_is_cat=ic, cat_words=cw)
         return np.asarray(out) + init_score
 
     def predict_leaf(self, x):
         s = self._used_trees()
+        ic, cw = self._cat_args(s)
         return np.asarray(trainer.predict_leaf_index(
             np.asarray(x, dtype=np.float32),
-            self.split_feature[s], self.threshold[s], self.max_depth))
+            self.split_feature[s], self.threshold[s], self.max_depth,
+            split_is_cat=ic, cat_words=cw))
 
     def feature_contributions(self, x):
         """Per-feature additive contributions via exact path-dependent
@@ -78,16 +95,19 @@ class Booster(NamedTuple):
         contrib = np.zeros((n, self.n_features + 1), dtype=np.float64)
         s = self._used_trees()
         sf, thr, lv = self.split_feature[s], self.threshold[s], self.leaf_value[s]
+        ic, cw = self._cat_args(s)
         if self.cover is None:
-            return self._saabas_contributions(x, sf, thr, lv)
+            return self._saabas_contributions(x, sf, thr, lv, ic, cw)
         cover = self.cover[s]
         for t in range(sf.shape[0]):
             phi = _tree_shap(sf[t], thr[t], lv[t], cover[t], x,
-                             self.n_features)
+                             self.n_features,
+                             is_cat=None if ic is None else ic[t],
+                             cat_words=None if cw is None else cw[t])
             contrib += phi
         return contrib
 
-    def _saabas_contributions(self, x, sf, thr, lv):
+    def _saabas_contributions(self, x, sf, thr, lv, ic=None, cw=None):
         """Legacy fallback: uniform-weight path attribution."""
         n = x.shape[0]
         contrib = np.zeros((n, self.n_features + 1), dtype=np.float64)
@@ -99,7 +119,11 @@ class Booster(NamedTuple):
                 f = sf[t][node]
                 leaf = f < 0
                 xf = x[np.arange(n), np.clip(f, 0, self.n_features - 1)]
-                child = np.where(xf <= thr[t][node], 2 * node + 1, 2 * node + 2)
+                go_left = xf <= thr[t][node]
+                if ic is not None:
+                    member = _cat_member_np(xf, cw[t][node])
+                    go_left = np.where(ic[t][node], member, go_left)
+                child = np.where(go_left, 2 * node + 1, 2 * node + 2)
                 nxt = np.where(leaf, node, child)
                 delta = ev[nxt] - ev[node]
                 np.add.at(contrib,
@@ -145,6 +169,9 @@ class Booster(NamedTuple):
             out["gain"] = self.gain
         if self.cover is not None:
             out["cover"] = self.cover
+        if self.split_is_cat is not None:
+            out["split_is_cat"] = self.split_is_cat
+            out["cat_words"] = self.cat_words
         return out
 
     @classmethod
@@ -157,6 +184,10 @@ class Booster(NamedTuple):
                    tree_class=np.asarray(d["tree_class"]),
                    gain=(np.asarray(d["gain"]) if "gain" in d else None),
                    cover=(np.asarray(d["cover"]) if "cover" in d else None),
+                   split_is_cat=(np.asarray(d["split_is_cat"], bool)
+                                 if "split_is_cat" in d else None),
+                   cat_words=(np.asarray(d["cat_words"], np.int32)
+                              if "cat_words" in d else None),
                    **meta)
 
     def save_model_string(self) -> str:
@@ -184,6 +215,16 @@ class Booster(NamedTuple):
             best = -1
         both_aux = self.gain is not None and other.gain is not None \
             and self.cover is not None and other.cover is not None
+        any_cat = self.split_is_cat is not None or other.split_is_cat is not None
+        if any_cat:
+            ic = np.concatenate([a[6], b[6]])
+            w16 = max(a[7].shape[2], b[7].shape[2])
+
+            def pw(w):
+                return np.pad(w, ((0, 0), (0, 0), (0, w16 - w.shape[2])))
+            cw = np.concatenate([pw(a[7]), pw(b[7])])
+        else:
+            ic = cw = None
         return Booster(
             split_feature=np.concatenate([a[0], b[0]]),
             threshold=np.concatenate([a[1], b[1]]),
@@ -193,7 +234,8 @@ class Booster(NamedTuple):
             max_depth=md, n_classes=self.n_classes, objective=self.objective,
             n_features=self.n_features, best_iteration=best,
             gain=np.concatenate([a[4], b[4]]) if both_aux else None,
-            cover=np.concatenate([a[5], b[5]]) if both_aux else None)
+            cover=np.concatenate([a[5], b[5]]) if both_aux else None,
+            split_is_cat=ic, cat_words=cw)
 
 
 def _pad_depth(b: Booster, max_depth: int):
@@ -202,16 +244,21 @@ def _pad_depth(b: Booster, max_depth: int):
     shape = (b.split_feature.shape[0], cur)
     gain = b.gain if b.gain is not None else np.zeros(shape, np.float32)
     cover = b.cover if b.cover is not None else np.zeros(shape, np.float32)
+    ic = (b.split_is_cat if b.split_is_cat is not None
+          else np.zeros(shape, bool))
+    cw = (b.cat_words if b.cat_words is not None
+          else np.zeros(shape + (0,), np.int32))
     if cur == target:
         return (b.split_feature, b.threshold, b.split_bin, b.leaf_value,
-                gain, cover)
+                gain, cover, ic, cw)
     pad = target - cur
 
     def p(a, fill):
         return np.pad(a, ((0, 0), (0, pad)), constant_values=fill)
     return (p(b.split_feature, -1), p(b.threshold, 0.0),
             p(b.split_bin, 0), p(b.leaf_value, 0.0),
-            p(gain, 0.0), p(cover, 0.0))
+            p(gain, 0.0), p(cover, 0.0), p(ic, False),
+            np.pad(cw, ((0, 0), (0, pad), (0, 0))))
 
 
 def _node_expectations(sf, lv):
@@ -225,7 +272,23 @@ def _node_expectations(sf, lv):
     return ev
 
 
-def _tree_shap(sf, thr, lv, cover, x, n_features):
+def _cat_member_np(xf, words_rows):
+    """Vectorized numpy category-membership: xf (n,) raw values, words_rows
+    (n, W16) packed 16-bit words. numpy oracle of trainer.raw_to_cat_bin +
+    trainer.packed_member — identity bin assignment mirrors
+    ops/binning.apply_bins (overflow ids share the top bin, negatives bin 0,
+    NaN -> last bin) so SHAP walks the same paths the model scores."""
+    w16 = words_rows.shape[-1]
+    if w16 == 0:
+        return np.zeros(xf.shape, bool)
+    top = w16 * 16 - 1
+    b = np.clip(np.ceil(xf - 0.5), 0, top)
+    b = np.where(np.isnan(xf), top, b).astype(np.int64)
+    word = words_rows[np.arange(xf.shape[0]), b >> 4]
+    return ((word >> (b & 15)) & 1) == 1
+
+
+def _tree_shap(sf, thr, lv, cover, x, n_features, is_cat=None, cat_words=None):
     """Exact path-dependent TreeSHAP for one heap tree, vectorized over rows.
 
     Transcription of TreeSHAP (Lundberg, Erion & Lee 2018, 'Consistent
@@ -307,6 +370,9 @@ def _tree_shap(sf, thr, lv, cover, x, n_features):
             return
         left, right = 2 * node + 1, 2 * node + 2
         hot_is_left = x[:, f] <= thr[node]
+        if is_cat is not None and is_cat[node]:
+            wrow = np.broadcast_to(cat_words[node], (n, cat_words.shape[-1]))
+            hot_is_left = _cat_member_np(x[:, f], wrow)
         c_node = max(float(cover[node]), 1e-12)
         rz_left = float(cover[left]) / c_node
         rz_right = float(cover[right]) / c_node

@@ -56,6 +56,14 @@ class BoostParams:
     # objective extras
     alpha: float = 0.9                # huber delta / quantile level
     tweedie_variance_power: float = 1.5
+    # native categorical splits (reference: categoricalSlotIndexes,
+    # lightgbm/params/LightGBMParams.scala:184-196): these features hold
+    # integer category ids; binning is identity and split search orders
+    # categories by gradient statistic per node (see trainer.TreeConfig)
+    categorical_features: tuple = ()
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
     # multiclass / ranking
     num_class: int = 1
     sigmoid: float = 1.0
@@ -244,7 +252,7 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
         count_w = _presence(pres_j, row_w)
         fmask = _feature_mask(p, k_feat, cfg.n_features)
 
-        sfs, sbs, lvs, gns, cvs = [], [], [], [], []
+        sfs, sbs, lvs, gns, cvs, ics, cws = [], [], [], [], [], [], []
         for k in range(k_out):
             gk = grad[:, k] if multiclass else grad
             hk = hess[:, k] if multiclass else hess
@@ -257,6 +265,8 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
             lvs.append(tree.leaf_value)
             gns.append(tree.gain)
             cvs.append(tree.cover)
+            ics.append(tree.split_is_cat)
+            cws.append(tree.cat_words)
             if multiclass:
                 margin = margin.at[:, k].add(delta)
             else:
@@ -264,7 +274,9 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
             if has_valid:
                 vd = trainer.predict_binned(v_bins, tree.split_feature,
                                             tree.split_bin, tree.leaf_value,
-                                            cfg.max_depth)
+                                            cfg.max_depth,
+                                            split_is_cat=tree.split_is_cat,
+                                            cat_words=tree.cat_words)
                 if multiclass:
                     v_margin = v_margin.at[:, k].add(vd)
                 else:
@@ -275,12 +287,13 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
         else:
             metric = jnp.float32(0.0)
         out = (jnp.stack(sfs), jnp.stack(sbs), jnp.stack(lvs),
-               jnp.stack(gns), jnp.stack(cvs), metric)
+               jnp.stack(gns), jnp.stack(cvs), jnp.stack(ics),
+               jnp.stack(cws), metric)
         return (margin, v_margin), out
 
     its = it_base + jnp.arange(chunk_len)
     keys = jax.random.split(key, chunk_len)
-    (margin, v_margin), (sf, sb, lv, gn, cv, metrics) = jax.lax.scan(
+    (margin, v_margin), (sf, sb, lv, gn, cv, ic, cw, metrics) = jax.lax.scan(
         one_iter, (margin, v_margin), (its, keys))
     # (chunk, K, max_nodes) -> (chunk*K, max_nodes), class-major per iteration
     sf = sf.reshape(-1, sf.shape[-1])
@@ -288,34 +301,56 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
     lv = lv.reshape(-1, lv.shape[-1])
     gn = gn.reshape(-1, gn.shape[-1])
     cv = cv.reshape(-1, cv.shape[-1])
-    return margin, v_margin, sf, sb, lv, gn, cv, metrics
+    ic = ic.reshape(-1, ic.shape[-1])
+    # explicit leading dim: reshape(-1) on a zero-width cat_words (no
+    # categorical features) would divide by zero
+    cw = cw.reshape(cw.shape[0] * cw.shape[1], cw.shape[2], cw.shape[3])
+    return margin, v_margin, sf, sb, lv, gn, cv, ic, cw, metrics
 
 
 def _fetch_packed(parts):
-    """One D2H round-trip for all chunk outputs: concat each of the five
-    (T, max_nodes) stacks across chunks on device, bitcast the integer ones
-    to f32, stack into a single (5, T, max_nodes) array and fetch it whole.
+    """One D2H round-trip for all chunk outputs: concat each of the seven
+    tree-array stacks across chunks on device, bitcast the integer ones to
+    f32, flatten everything into ONE 1-D device array and fetch it whole.
     Per-array fetches each pay a full transfer round-trip, which dominates
     wall time on high-latency device links."""
     cat = [parts[0][i] if len(parts) == 1
-           else jnp.concatenate([p[i] for p in parts]) for i in range(5)]
-    packed = jnp.stack([
-        jax.lax.bitcast_convert_type(cat[0].astype(jnp.int32), jnp.float32),
-        jax.lax.bitcast_convert_type(cat[1].astype(jnp.int32), jnp.float32),
-        cat[2].astype(jnp.float32), cat[3].astype(jnp.float32),
-        cat[4].astype(jnp.float32)])
-    host = np.asarray(packed)
-    return (host[0].view(np.int32), host[1].view(np.int32),
-            host[2], host[3], host[4])
+           else jnp.concatenate([p[i] for p in parts]) for i in range(7)]
+    sf, sb, lv, gn, cv, ic, cw = cat
+    planes = [
+        jax.lax.bitcast_convert_type(sf.astype(jnp.int32), jnp.float32),
+        jax.lax.bitcast_convert_type(sb.astype(jnp.int32), jnp.float32),
+        lv.astype(jnp.float32), gn.astype(jnp.float32),
+        cv.astype(jnp.float32), ic.astype(jnp.float32),
+        jax.lax.bitcast_convert_type(cw.astype(jnp.int32), jnp.float32),
+    ]
+    shapes = [p_.shape for p_ in planes]
+    flat = jnp.concatenate([p_.reshape(-1) for p_ in planes])
+    host = np.asarray(flat)
+    out, off = [], 0
+    for s in shapes:
+        size = int(np.prod(s)) if s else 1
+        out.append(host[off:off + size].reshape(s))
+        off += size
+    return (out[0].view(np.int32), out[1].view(np.int32), out[2], out[3],
+            out[4], out[5] > 0.5, out[6].view(np.int32))
 
 
 def _build_booster(sf, sb, lv, tree_classes, mapper, p: BoostParams,
                    k_out: int, n_features: int, best_iter: int,
-                   init_booster, base, gain=None, cover=None):
-    """Stacked tree arrays -> Booster with real-valued thresholds."""
+                   init_booster, base, gain=None, cover=None,
+                   is_cat=None, cat_words=None):
+    """Stacked tree arrays -> Booster with real-valued thresholds.
+
+    Categorical split nodes keep threshold 0 — they route by the packed
+    membership words, not a value compare (raw inputs are category ids)."""
     thr = mapper.upper_bounds[np.clip(sf, 0, n_features - 1),
                               np.clip(sb, 0, p.max_bin - 1)]
     thr = np.where(sf >= 0, thr, 0.0).astype(np.float32)
+    has_cat = (is_cat is not None and cat_words is not None
+               and cat_words.size and is_cat.any())
+    if has_cat:
+        thr = np.where(is_cat, 0.0, thr).astype(np.float32)
     booster = Booster(split_feature=sf.astype(np.int32), threshold=thr,
                       split_bin=sb.astype(np.int32),
                       leaf_value=lv.astype(np.float32),
@@ -324,7 +359,10 @@ def _build_booster(sf, sb, lv, tree_classes, mapper, p: BoostParams,
                       objective=p.objective, n_features=n_features,
                       best_iteration=best_iter,
                       gain=None if gain is None else gain.astype(np.float32),
-                      cover=None if cover is None else cover.astype(np.float32))
+                      cover=None if cover is None else cover.astype(np.float32),
+                      split_is_cat=(is_cat.astype(bool) if has_cat else None),
+                      cat_words=(cat_words.astype(np.int32) if has_cat
+                                 else None))
     if init_booster is not None:
         booster = init_booster.merge(booster)
     return booster
@@ -367,7 +405,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         mapper, d_bins = prebinned
         d_bins = put(d_bins)
     else:
-        mapper = binning.fit_bins(x, max_bin=p.max_bin, seed=p.seed)
+        mapper = binning.fit_bins(x, max_bin=p.max_bin, seed=p.seed,
+                                  categorical_features=p.categorical_features)
         d_bins = put(binning.apply_bins_device(mapper, x))
     y_j = put(np.asarray(y, dtype=np.float32))
     w_j = None if weights is None else put(np.asarray(weights, dtype=np.float32))
@@ -432,7 +471,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                     lambda_l1=p.lambda_l1, lambda_l2=p.lambda_l2,
                     min_gain_to_split=p.min_gain_to_split,
                     min_data_in_leaf=p.min_data_in_leaf,
-                    min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf)
+                    min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf,
+                    categorical_features=tuple(p.categorical_features),
+                    cat_smooth=p.cat_smooth, cat_l2=p.cat_l2,
+                    max_cat_threshold=p.max_cat_threshold)
 
     rf = p.boosting == "rf"
     dart = p.boosting == "dart"
@@ -484,19 +526,21 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         while it < p.num_iterations:
             clen = min(chunk, p.num_iterations - it)
             key, kc = jax.random.split(key)
-            margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, mts = fused(
+            (margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c,
+             mts) = fused(
                 d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_, vy_j,
                 v_margin_, kc, it, p, cfg, clen, k_out, has_valid=has_valid)
-            parts.append((sf_c, sb_c, lv_c, gn_c, cv_c))
+            parts.append((sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c))
             if checkpoint_fn is not None:
                 # chunk boundary = natural checkpoint step: build the
                 # booster-so-far from the accumulated parts (host-cheap)
-                _sf, _sb, _lv, _gn, _cv = _fetch_packed(parts)
+                _sf, _sb, _lv, _gn, _cv, _ic, _cw = _fetch_packed(parts)
                 _tc = np.tile(np.arange(k_out, dtype=np.int32),
                               _sf.shape[0] // max(k_out, 1))
                 checkpoint_fn(it + clen, _build_booster(
                     _sf, _sb, _lv, _tc, mapper, p, k_out, n_features, -1,
-                    init_booster, base, gain=_gn, cover=_cv), base,
+                    init_booster, base, gain=_gn, cover=_cv, is_cat=_ic,
+                    cat_words=_cw), base,
                     final=False)
             if track:
                 for i, mv in enumerate(np.asarray(mts)):
@@ -519,11 +563,11 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         # full transfer round-trip (5 serial fetches measured ~0.5s over a
         # tunneled link), so pack the five (T, max_nodes) arrays into a
         # single f32 device array (bitcasting the i32 ones) and fetch once.
-        sf, sb, lv, gn, cv = _fetch_packed(parts)
+        sf, sb, lv, gn, cv, ic, cw = _fetch_packed(parts)
         if stop_at is not None:  # drop trees grown past the stopping point
             keep = stop_at * k_out
             sf, sb, lv = sf[:keep], sb[:keep], lv[:keep]
-            gn, cv = gn[:keep], cv[:keep]
+            gn, cv, ic, cw = gn[:keep], cv[:keep], ic[:keep], cw[:keep]
             if checkpoint_fn is not None:
                 # overwrite the overgrown chunk checkpoint with the truncated
                 # state and mark training COMPLETE so a re-fit doesn't
@@ -532,14 +576,15 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                               sf.shape[0] // max(k_out, 1))
                 checkpoint_fn(stop_at, _build_booster(
                     sf, sb, lv, tc_, mapper, p, k_out, n_features,
-                    best_iter, init_booster, base, gain=gn, cover=cv),
+                    best_iter, init_booster, base, gain=gn, cover=cv,
+                    is_cat=ic, cat_words=cw),
                     base, final=True)
         tree_classes = np.tile(np.arange(k_out, dtype=np.int32),
                                sf.shape[0] // max(k_out, 1))
         booster = _build_booster(
             sf, sb, lv, tree_classes, mapper, p, k_out, n_features,
             best_iter if (track and patience > 0) else -1, init_booster, base,
-            gain=gn, cover=cv)
+            gain=gn, cover=cv, is_cat=ic, cat_words=cw)
         return booster, base, eval_history
 
     trees, tree_classes, train_deltas = [], [], []
@@ -609,7 +654,9 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 # slowly when labels aren't unit-scale).
                 q = p.alpha if p.objective == "quantile" else 0.5
                 nodes = np.asarray(trainer.leaf_of_binned(
-                    d_bins, tree.split_feature, tree.split_bin, p.max_depth))
+                    d_bins, tree.split_feature, tree.split_bin, p.max_depth,
+                    split_is_cat=tree.split_is_cat,
+                    cat_words=tree.cat_words))
                 resid = np.asarray(y_j) - np.asarray(margin_used)
                 w_np = None if w_j is None else np.asarray(w_j)
                 lv = np.asarray(tree.leaf_value)
@@ -631,7 +678,9 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             if has_valid:
                 vd = trainer.predict_binned(v_bins, tree.split_feature,
                                             tree.split_bin, tree.leaf_value,
-                                            p.max_depth)
+                                            p.max_depth,
+                                            split_is_cat=tree.split_is_cat,
+                                            cat_words=tree.cat_words)
                 if multiclass:
                     v_it_delta = v_it_delta.at[:, k].add(vd)
                 else:
@@ -687,13 +736,15 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             _lv = np.stack([tr.leaf_value for tr in trees])
             _gn = np.stack([tr.gain for tr in trees])
             _cv = np.stack([tr.cover for tr in trees])
+            _ic = np.stack([tr.split_is_cat for tr in trees])
+            _cw = np.stack([tr.cat_words for tr in trees])
             if dart:
                 _w = np.repeat(np.asarray(dart_weights, np.float32), k_out)
                 _lv = _lv * _w[:, None]
             checkpoint_fn(it + 1, _build_booster(
                 _sf, _sb, _lv, np.asarray(tree_classes, np.int32), mapper, p,
                 k_out, n_features, -1, init_booster, base, gain=_gn,
-                cover=_cv), base, final=False)
+                cover=_cv, is_cat=_ic, cat_words=_cw), base, final=False)
 
     max_nodes = 2 ** (p.max_depth + 1) - 1
     T = len(trees)
@@ -702,13 +753,15 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     lv = np.stack([t.leaf_value for t in trees]) if T else np.zeros((0, max_nodes), np.float32)
     gn = np.stack([t.gain for t in trees]) if T else np.zeros((0, max_nodes), np.float32)
     cv = np.stack([t.cover for t in trees]) if T else np.zeros((0, max_nodes), np.float32)
+    ic = np.stack([t.split_is_cat for t in trees]) if T else np.zeros((0, max_nodes), bool)
+    cw = np.stack([t.cat_words for t in trees]) if T else np.zeros((0, max_nodes, 0), np.int32)
     if dart and T:
         per_iter_w = np.repeat(np.asarray(dart_weights, np.float32), k_out)
         lv = lv * per_iter_w[:, None]
     final_booster = _build_booster(
         sf, sb, lv, np.asarray(tree_classes, np.int32), mapper, p, k_out,
         n_features, best_iter if p.early_stopping_round > 0 else -1,
-        init_booster, base, gain=gn, cover=cv)
+        init_booster, base, gain=gn, cover=cv, is_cat=ic, cat_words=cw)
     if (checkpoint_fn is not None and p.early_stopping_round > 0
             and rounds_since >= p.early_stopping_round):
         # early stop: persist the truncated model and mark training complete

@@ -45,7 +45,8 @@ def _compiled_tree_fn(mesh, cfg, voting: Optional[int]):
         fn, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(),
                   P(DATA_AXIS)),
-        out_specs=(trainer.Tree(P(), P(), P(), P(), P()), P(DATA_AXIS)),
+        out_specs=(trainer.Tree(P(), P(), P(), P(), P(), P(), P()),
+                   P(DATA_AXIS)),
         check_rep=False)
     return jax.jit(mapped)
 
@@ -80,7 +81,7 @@ def _compiled_chunk_fn(mesh, p, cfg, chunk_len: int, k_out: int,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), margin_spec, margin_spec, P(), P(), P(), P(),
                   P()),
-        out_specs=(margin_spec, P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(margin_spec, P(), P(), P(), P(), P(), P(), P(), P(), P()),
         check_rep=False)
     return jax.jit(mapped)
 

@@ -71,6 +71,25 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     num_tasks = Param("num_tasks", "override worker count (0=all mesh devices)", 0)
     sigmoid = Param("sigmoid", "sigmoid scale for binary objective", 1.0)
     verbosity = Param("verbosity", "log level", -1)
+    # native categorical splits (reference: categoricalSlotIndexes /
+    # categoricalSlotNames, lightgbm/params/LightGBMParams.scala:184-196).
+    # Listed feature slots hold integer category ids; they are identity-
+    # binned and split by sorted-by-gradient category sets instead of the
+    # artificial ordinal ordering. Names resolve against the features
+    # column's `feature_names` metadata when present.
+    categorical_slot_indexes = Param(
+        "categorical_slot_indexes",
+        "feature slots to treat as categorical", ())
+    categorical_slot_names = Param(
+        "categorical_slot_names",
+        "feature names to treat as categorical (resolved via the features "
+        "column's feature_names metadata)", ())
+    cat_smooth = Param("cat_smooth",
+                       "categorical sort-ratio smoothing", 10.0)
+    cat_l2 = Param("cat_l2", "extra L2 for categorical splits", 10.0)
+    max_cat_threshold = Param(
+        "max_cat_threshold",
+        "max categories on the smaller side of a categorical split", 32)
     leaf_prediction_col = Param("leaf_prediction_col",
                                 "output column for per-tree leaf indices", None)
     features_shap_col = Param("features_shap_col",
@@ -112,7 +131,35 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             num_class=num_class, sigmoid=self.sigmoid, seed=self.seed,
             early_stopping_round=self.early_stopping_round, metric=self.metric,
             boost_from_average=self.boost_from_average,
+            categorical_features=tuple(
+                int(i) for i in (self.categorical_slot_indexes or ())),
+            cat_smooth=self.cat_smooth, cat_l2=self.cat_l2,
+            max_cat_threshold=self.max_cat_threshold,
             verbosity=self.verbosity)
+
+    def _resolve_categoricals(self, table: Table, params: BoostParams):
+        """Merge categorical_slot_names (via feature_names metadata) into
+        the slot-index set (reference: LightGBMBase resolves slot names
+        against the assembled vector's attribute names)."""
+        names = tuple(self.categorical_slot_names or ())
+        if not names:
+            return params
+        feature_names = table.column_meta(self.features_col).get(
+            "feature_names")
+        if feature_names is None:
+            raise ValueError(
+                "categorical_slot_names given but the features column "
+                f"{self.features_col!r} carries no feature_names metadata; "
+                "use categorical_slot_indexes or attach names via "
+                "Table.with_column_meta")
+        name_to_idx = {nm: i for i, nm in enumerate(feature_names)}
+        missing = [nm for nm in names if nm not in name_to_idx]
+        if missing:
+            raise KeyError(f"categorical_slot_names not in feature_names: "
+                           f"{missing}")
+        merged = tuple(sorted(set(params.categorical_features)
+                              | {name_to_idx[nm] for nm in names}))
+        return dataclasses.replace(params, categorical_features=merged)
 
     def _split_validation(self, table: Table):
         vcol = self.validation_indicator_col
@@ -142,7 +189,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                callbacks: Optional[Callbacks] = None):
         train, valid = self._split_validation(table)
         x, y, w, init = self._fit_data(train)
-        params = self._boost_params(objective, num_class)
+        params = self._resolve_categoricals(
+            table, self._boost_params(objective, num_class))
         n_batches = self.num_batches or 0
 
         # step-level checkpoint/resume (SURVEY.md §5); single-batch fits only

@@ -29,6 +29,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...ops.histogram import node_feature_histograms
 
@@ -45,19 +46,41 @@ class TreeConfig(NamedTuple):
     min_gain_to_split: float = 0.0
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
+    # native categorical splits (reference: categoricalSlotIndexes,
+    # lightgbm/params/LightGBMParams.scala:184-196): listed features hold
+    # integer category ids (identity-binned); their split search orders bins
+    # by gradient statistic per node (LightGBM's sorted one-vs-rest) instead
+    # of the artificial ordinal `bin <= threshold` ordering
+    categorical_features: tuple = ()
+    cat_smooth: float = 10.0          # sort-ratio denominator smoothing
+    cat_l2: float = 10.0              # extra L2 for categorical split gains
+    max_cat_threshold: int = 32       # cap on the smaller side's category count
 
     @property
     def max_nodes(self) -> int:
         return 2 ** (self.max_depth + 1) - 1
 
+    @property
+    def cat_words_width(self) -> int:
+        """Packed category-membership width: 16-bit words (halfwords stay
+        exact through the f32 one-hot routing matmuls on deep levels).
+        0 when no categorical features — every cat code path then vanishes
+        at trace time and the numeric-only program is unchanged."""
+        if not self.categorical_features:
+            return 0
+        return (self.n_bins + 15) // 16
+
 
 class Tree(NamedTuple):
-    """One grown tree as dense heap arrays (all shape (max_nodes,))."""
+    """One grown tree as dense heap arrays (all shape (max_nodes,) except
+    cat_words: (max_nodes, cat_words_width))."""
     split_feature: jnp.ndarray  # i32; -1 where the node is a leaf
     split_bin: jnp.ndarray      # i32 bin threshold: go left if bin <= split_bin
     leaf_value: jnp.ndarray     # f32 output where rows rest
     gain: jnp.ndarray           # f32 split gain at internal nodes (0 at leaves)
     cover: jnp.ndarray          # f32 row count through each node (for SHAP)
+    split_is_cat: jnp.ndarray   # bool; True = route by category membership
+    cat_words: jnp.ndarray      # i32 packed 16-bit membership words per node
 
 
 def _soft_threshold(g, l1):
@@ -106,15 +129,84 @@ def _gain_lattice(hg, hh, hc, feature_mask, cfg: TreeConfig,
 
 def _best_splits_for_level(hg, hh, hc, feature_mask, cfg: TreeConfig,
                            parent_g, parent_h, parent_c):
-    """Vectorized split search; returns per-node (gain, feature, bin)."""
-    gain = _gain_lattice(hg, hh, hc, feature_mask, cfg,
-                         parent_g, parent_h, parent_c)
-    flat = gain.reshape(gain.shape[0], -1)
+    """Vectorized split search; returns per-node (gain, feature, bin,
+    is_cat, cat_words). With no categorical features the last two are
+    constant False / zero-width and the search is the numeric lattice alone.
+
+    Categorical features (LightGBM's sorted one-vs-rest, feature_histogram
+    FindBestThresholdCategorical): per node, order that feature's bins by
+    grad/(hess + cat_smooth), then the SAME cumsum split search runs over
+    the permuted lattice — a split at sorted position p means 'the p+1
+    lowest-ratio categories go left', a set, not an interval. The winning
+    prefix is packed into 16-bit membership words for gather-free routing.
+    """
+    m = hg.shape[0]
+    cat = tuple(cfg.categorical_features)
+    if not cat:
+        gain = _gain_lattice(hg, hh, hc, feature_mask, cfg,
+                             parent_g, parent_h, parent_c)
+        flat = gain.reshape(m, -1)
+        best_idx = jnp.argmax(flat, axis=-1)
+        best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=-1)[:, 0]
+        return (best_gain, (best_idx // cfg.n_bins).astype(jnp.int32),
+                (best_idx % cfg.n_bins).astype(jnp.int32),
+                jnp.zeros(m, bool), jnp.zeros((m, 0), jnp.int32))
+
+    F, B, C = cfg.n_features, cfg.n_bins, len(cat)
+    cat_np = np.asarray(cat, np.int32)
+    num_mask = np.ones(F, bool)
+    num_mask[cat_np] = False
+    gain_num = _gain_lattice(hg, hh, hc, feature_mask & jnp.asarray(num_mask),
+                             cfg, parent_g, parent_h, parent_c)
+
+    # categorical lattice: slice, sort bins by gradient statistic, re-search
+    cg, chs, ccn = hg[:, cat_np], hh[:, cat_np], hc[:, cat_np]  # (m, C, B)
+    ratio = cg / (chs + cfg.cat_smooth)
+    # empty bins sort LAST so they never occupy prefix positions (unseen
+    # categories at predict time therefore route right, LightGBM's default)
+    ratio = jnp.where(ccn > 0, ratio, jnp.inf)
+    order = jnp.argsort(ratio, axis=-1)                          # (m, C, B)
+    sg = jnp.take_along_axis(cg, order, axis=-1)
+    sh = jnp.take_along_axis(chs, order, axis=-1)
+    sc = jnp.take_along_axis(ccn, order, axis=-1)
+    cfg_cat = cfg._replace(lambda_l2=cfg.lambda_l2 + cfg.cat_l2)
+    gain_cat = _gain_lattice(sg, sh, sc, feature_mask[cat_np], cfg_cat,
+                             parent_g, parent_h, parent_c)
+    # max_cat_threshold (LightGBM): the SMALLER side of a categorical split
+    # may hold at most this many categories — full-prefix scan covers both
+    # scan directions, so cap either side
+    nnz = (ccn > 0).sum(-1, keepdims=True)                       # (m, C, 1)
+    left_cats = jnp.minimum(jnp.arange(B)[None, None, :] + 1, nnz)
+    ok_cat = ((left_cats <= cfg.max_cat_threshold)
+              | (nnz - left_cats <= cfg.max_cat_threshold))
+    gain_cat = jnp.where(ok_cat, gain_cat, -jnp.inf)
+
+    flat = jnp.concatenate([gain_num.reshape(m, -1),
+                            gain_cat.reshape(m, -1)], axis=1)
     best_idx = jnp.argmax(flat, axis=-1)
     best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=-1)[:, 0]
-    best_feature = best_idx // cfg.n_bins
-    best_bin = best_idx % cfg.n_bins
-    return best_gain, best_feature.astype(jnp.int32), best_bin.astype(jnp.int32)
+    is_cat = best_idx >= F * B
+    cat_rel = jnp.clip(best_idx - F * B, 0, C * B - 1)
+    cidx = cat_rel // B                                          # (m,)
+    cpos = cat_rel % B
+    feat = jnp.where(is_cat, jnp.asarray(cat_np)[cidx],
+                     (best_idx // B).astype(jnp.int32)).astype(jnp.int32)
+    thr = jnp.where(is_cat, cpos, best_idx % B).astype(jnp.int32)
+
+    # membership of the winning prefix: bin b goes left iff its rank in the
+    # winning feature's sort order is <= cpos AND the bin is non-empty
+    take_c = cidx[:, None, None]
+    order_win = jnp.take_along_axis(order, take_c, axis=1)[:, 0]  # (m, B)
+    rank = jnp.argsort(order_win, axis=-1)                        # inverse perm
+    cc_win = jnp.take_along_axis(ccn, take_c, axis=1)[:, 0]
+    member = (rank <= cpos[:, None]) & (cc_win > 0) & is_cat[:, None]
+    w16 = cfg.cat_words_width
+    pad = w16 * 16 - B
+    if pad:
+        member = jnp.pad(member, ((0, 0), (0, pad)))
+    pow2 = jnp.asarray(1 << np.arange(16), jnp.int32)
+    words = (member.reshape(m, w16, 16).astype(jnp.int32) * pow2).sum(-1)
+    return best_gain, feat, thr, is_cat, words
 
 
 def _voting_feature_mask(hg, hh, hc, feature_mask, cfg: TreeConfig,
@@ -164,11 +256,14 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     the one collective per level that makes training data-parallel.
     """
     n = bins.shape[0]
+    w16 = cfg.cat_words_width   # 0 = no categorical features (code vanishes)
     node_of_row = jnp.zeros(n, dtype=jnp.int32)
     split_feature = jnp.full(cfg.max_nodes, -1, dtype=jnp.int32)
     split_bin = jnp.zeros(cfg.max_nodes, dtype=jnp.int32)
     gain_arr = jnp.zeros(cfg.max_nodes, dtype=jnp.float32)
     cover_arr = jnp.zeros(cfg.max_nodes, dtype=jnp.float32)
+    is_cat_arr = jnp.zeros(cfg.max_nodes, dtype=bool)
+    cat_words_arr = jnp.zeros((cfg.max_nodes, w16), dtype=jnp.int32)
     leaf_count = jnp.ones((), dtype=jnp.int32)
     # feature-major bins for row routing: one (n,)-stripe dynamic-slice per
     # split node beats any (n, F) materialization; shared with pallas_hist's
@@ -232,7 +327,7 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                             hc[:, 0].sum(-1))
         level_fmask = feature_mask if not voting else jnp.ones_like(feature_mask)
 
-        gain, feat, thr = _best_splits_for_level(
+        gain, feat, thr, is_cat, words = _best_splits_for_level(
             hg, hh, hc, level_fmask, cfg, parent_g, parent_h, parent_c)
         gain = jnp.where(child_valid, gain, -jnp.inf)
         prev_hists = (hg, hh, hc)
@@ -250,6 +345,11 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         split_feature = split_feature.at[heap_ids].set(
             jnp.where(apply, feat, -1))
         split_bin = split_bin.at[heap_ids].set(jnp.where(apply, thr, 0))
+        if w16:
+            applied_cat = apply & is_cat
+            is_cat_arr = is_cat_arr.at[heap_ids].set(applied_cat)
+            cat_words_arr = cat_words_arr.at[heap_ids].set(
+                jnp.where(applied_cat[:, None], words, 0))
         # bookkeeping for SHAP/importance: gains of applied splits, and the
         # row count (cover) of every node at this level
         gain_arr = gain_arr.at[heap_ids].set(
@@ -269,19 +369,31 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 bj = jax.lax.dynamic_index_in_dim(bins_t, feat[j], 0,
                                                   keepdims=False)  # (n,) u8
                 heap_j = level_base + j
-                child_j = jnp.where(bj.astype(jnp.int32) <= thr[j],
-                                    2 * heap_j + 1, 2 * heap_j + 2)
+                bj32 = bj.astype(jnp.int32)
+                go_left = bj32 <= thr[j]
+                if w16:
+                    # category membership via the shared gather-free
+                    # bit-test (pure fused VPU ops, no table gather over n)
+                    member = packed_member(bj32, words[j])
+                    go_left = jnp.where(is_cat[j], member, go_left)
+                child_j = jnp.where(go_left, 2 * heap_j + 1, 2 * heap_j + 2)
                 upd = (node_local == j) & apply[j]
                 node_of_row = jnp.where(upd, child_j, node_of_row)
         else:
             # deep levels (m > 64): unrolling would blow up the program;
             # one-hot contractions cost O(n*(m+F)) but stay fully parallel.
             node_oh = jax.nn.one_hot(node_local, m, dtype=jnp.float32)
-            tbl = jnp.stack([feat.astype(jnp.float32), thr.astype(jnp.float32),
-                             apply.astype(jnp.float32)], axis=1)  # (m, 3)
+            cols = [feat.astype(jnp.float32), thr.astype(jnp.float32),
+                    apply.astype(jnp.float32)]
+            if w16:
+                # halfword membership columns stay exact in f32 (< 2^16)
+                cols.append(is_cat.astype(jnp.float32))
+            tbl = jnp.stack(cols, axis=1)
+            if w16:
+                tbl = jnp.concatenate([tbl, words.astype(jnp.float32)], axis=1)
             # HIGHEST precision: bf16 operands would round feature ids > 256
             rows = jnp.matmul(node_oh, tbl,
-                              precision=jax.lax.Precision.HIGHEST)  # (n, 3)
+                              precision=jax.lax.Precision.HIGHEST)  # (n, 3+)
             row_feat = rows[:, 0].astype(jnp.int32)
             row_thr = rows[:, 1].astype(jnp.int32)
             row_apply = active & (rows[:, 2] > 0.5)
@@ -290,6 +402,10 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             row_bin = jnp.sum(bins.astype(jnp.float32) * feat_oh,
                               axis=1).astype(jnp.int32)
             go_left = row_bin <= row_thr
+            if w16:
+                row_words = rows[:, 4:4 + w16].astype(jnp.int32)  # (n, W16)
+                member = packed_member(row_bin, row_words)
+                go_left = jnp.where(rows[:, 3] > 0.5, member, go_left)
             child = jnp.where(go_left, 2 * node_of_row + 1, 2 * node_of_row + 2)
             node_of_row = jnp.where(row_apply, child, node_of_row)
 
@@ -311,7 +427,8 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           seg_c.astype(jnp.float32), cover_arr)
 
     tree = Tree(split_feature=split_feature, split_bin=split_bin,
-                leaf_value=leaf_value, gain=gain_arr, cover=cover_arr)
+                leaf_value=leaf_value, gain=gain_arr, cover=cover_arr,
+                split_is_cat=is_cat_arr, cat_words=cat_words_arr)
     delta = jnp.matmul(rest_oh, leaf_value[:, None],
                        precision=jax.lax.Precision.HIGHEST)[:, 0]
     return tree, delta
@@ -372,13 +489,57 @@ def _select_chain_descend(go_right_bits, values, max_depth: int):
 _SELECT_CHAIN_MAX_DEPTH = 8
 
 
-def _chain_score(feat_rows_t, sf_t, thr_t, payload, max_depth: int):
-    """Shared select-chain scoring for one tree: slice each node's feature
-    row, compare against its threshold, descend. ~(x <= thr) routes NaN
-    RIGHT (missing = largest, ops/binning semantics); for integer bins the
-    form is identical to x > thr."""
-    xsel = feat_rows_t[jnp.clip(sf_t, 0, feat_rows_t.shape[0] - 1)]
+def packed_member(b, words):
+    """Membership bit of category bin `b` in packed 16-bit words —
+    THE single bit-test every routing path shares (training stripe loop,
+    deep one-hot loop, select-chain predict, gather predict), so binned and
+    raw descent can never diverge. Gather-free: a W16-way where-chain picks
+    the word, then shift+mask.
+
+    b: int32 (...) bin ids; words: int32 (..., W16) with leading dims
+    broadcastable against b (e.g. (m, 1, W16) vs b (m, n))."""
+    w16 = words.shape[-1]
+    widx = b >> 4
+    wv = jnp.broadcast_to(words[..., 0], b.shape)
+    for w in range(1, w16):
+        wv = jnp.where(widx == w, jnp.broadcast_to(words[..., w], b.shape), wv)
+    return ((wv >> (b & 15)) & 1) == 1
+
+
+def raw_to_cat_bin(x, w16: int):
+    """Raw categorical value -> bin id, mirroring ops/binning.apply_bins for
+    identity-binned columns EXACTLY (train/serve skew would be worse than
+    any other semantic choice): searchsorted over k+0.5 bounds == ceil(x -
+    0.5) clipped, so ids above the range share the overflow bin, negatives
+    share bin 0, NaN -> last bin. (When max_bin+1 is not a multiple of 16
+    the padded last-word bins are never members and NaN then routes right;
+    the default 64/256 bin counts are exact.)"""
+    top = w16 * 16 - 1
+    b = jnp.clip(jnp.ceil(x - 0.5), 0, top)
+    return jnp.where(jnp.isnan(x), top, b).astype(jnp.int32)
+
+
+def _route_bits(xsel, thr_t, is_cat=None, words=None, binned=False):
+    """(max_nodes, n) go-RIGHT bits. Numeric nodes: ~(x <= thr) (routes NaN
+    RIGHT — missing = largest, ops/binning semantics). Categorical nodes:
+    membership bit-test of the value's identity bin in the node's packed
+    category words."""
     bits = ~(xsel <= thr_t[:, None])
+    if is_cat is None or words is None or words.shape[-1] == 0:
+        return bits
+    b = xsel.astype(jnp.int32) if binned \
+        else raw_to_cat_bin(xsel, words.shape[-1])
+    member = packed_member(b, words[:, None, :])
+    return jnp.where(is_cat[:, None], ~member, bits)
+
+
+def _chain_score(feat_rows_t, sf_t, thr_t, payload, max_depth: int,
+                 is_cat=None, words=None, binned=False):
+    """Shared select-chain scoring for one tree: slice each node's feature
+    row, compute its routing bit (threshold compare or category membership),
+    descend."""
+    xsel = feat_rows_t[jnp.clip(sf_t, 0, feat_rows_t.shape[0] - 1)]
+    bits = _route_bits(xsel, thr_t, is_cat, words, binned)
     return _select_chain_descend(bits, payload, max_depth)
 
 
@@ -389,109 +550,165 @@ def _heap_ids(sf_stack):
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
-def predict_binned(bins, split_feature, split_bin, leaf_value, max_depth: int):
+def predict_binned(bins, split_feature, split_bin, leaf_value, max_depth: int,
+                   split_is_cat=None, cat_words=None):
     """Score binned rows through one tree (train-time validation margins,
     DART re-scoring). Same gather-free select-chain descent as predict_raw;
     deep trees use the O(depth) gather descent."""
     if max_depth > _SELECT_CHAIN_MAX_DEPTH:
         nodes = _leaf_of_binned_gather(bins, split_feature, split_bin,
-                                       max_depth)
+                                       max_depth, split_is_cat, cat_words)
         return leaf_value[nodes]
     bins_t = bins.T.astype(jnp.int32)  # (F, n)
     sf, sb, lv = _propagate_leaves(
         split_feature[None], split_bin[None].astype(jnp.int32),
         leaf_value[None], max_depth, jnp.int32(2 ** 30))
-    return _chain_score(bins_t, sf[0], sb[0], lv[0], max_depth)
+    return _chain_score(bins_t, sf[0], sb[0], lv[0], max_depth,
+                        is_cat=split_is_cat, words=cat_words, binned=True)
 
 
-def _leaf_of_binned_gather(bins, split_feature, split_bin, max_depth: int):
+def _gather_cat_left(go_left, b, node, is_cat, words):
+    """Membership override for the gather descents: fetch each row's node
+    words (one (n, w16) gather — these paths already gather per level),
+    then the shared bit-test."""
+    member = packed_member(b, words[node])
+    return jnp.where(is_cat[node], member, go_left)
+
+
+def _leaf_of_binned_gather(bins, split_feature, split_bin, max_depth: int,
+                           split_is_cat=None, cat_words=None):
     n = bins.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
+    has_cat = split_is_cat is not None and cat_words is not None \
+        and cat_words.shape[-1] > 0
     for _ in range(max_depth):
         f = split_feature[node]
         is_leaf = f < 0
         b = jnp.take_along_axis(bins, jnp.clip(f, 0, bins.shape[1] - 1)[:, None],
                                 axis=1)[:, 0].astype(jnp.int32)
-        child = jnp.where(b <= split_bin[node], 2 * node + 1, 2 * node + 2)
+        go_left = b <= split_bin[node]
+        if has_cat:
+            go_left = _gather_cat_left(go_left, b, node, split_is_cat,
+                                       cat_words)
+        child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
         node = jnp.where(is_leaf, node, child)
     return node
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
-def leaf_of_binned(bins, split_feature, split_bin, max_depth: int):
+def leaf_of_binned(bins, split_feature, split_bin, max_depth: int,
+                   split_is_cat=None, cat_words=None):
     """ORIGINAL resting heap-node id per binned row (leaf-output renewal):
     select-chain over propagated node ids, gather fallback for deep trees."""
     if max_depth > _SELECT_CHAIN_MAX_DEPTH:
         return _leaf_of_binned_gather(bins, split_feature, split_bin,
-                                      max_depth)
+                                      max_depth, split_is_cat, cat_words)
     bins_t = bins.T.astype(jnp.int32)
     sf, sb, _, ids = _propagate_leaves(
         split_feature[None], split_bin[None].astype(jnp.int32),
         jnp.zeros_like(split_bin, jnp.float32)[None], max_depth,
         jnp.int32(2 ** 30), ids=_heap_ids(split_feature[None]))
-    return _chain_score(bins_t, sf[0], sb[0], ids[0], max_depth)
+    return _chain_score(bins_t, sf[0], sb[0], ids[0], max_depth,
+                        is_cat=split_is_cat, words=cat_words, binned=True)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_classes"))
 def predict_raw(x, split_feature, threshold, leaf_value, tree_class,
-                max_depth: int, n_classes: int):
+                max_depth: int, n_classes: int,
+                split_is_cat=None, cat_words=None):
     """Ensemble raw scores on UNbinned f32 features.
 
     Arrays are stacked over trees: (T, max_nodes). Thresholds are real-valued
     bin upper bounds so no BinMapper is needed at serve time (same trick as
-    LightGBM model files). Returns (n, n_classes) margins (squeezed by caller
-    for single-output objectives).
+    LightGBM model files). Categorical split nodes (split_is_cat True) route
+    by membership of floor(x) in the node's packed category set — raw values
+    ARE the integer category ids (identity binning, ops/binning.py).
+    Returns (n, n_classes) margins (squeezed by caller for single-output
+    objectives).
     """
     n = x.shape[0]
     if max_depth > _SELECT_CHAIN_MAX_DEPTH:
         return _predict_raw_gather(x, split_feature, threshold, leaf_value,
-                                   tree_class, max_depth, n_classes)
+                                   tree_class, max_depth, n_classes,
+                                   split_is_cat, cat_words)
     x_t = x.T  # (F, n): per-node feature rows slice out contiguously
     sf, thr, lv = _propagate_leaves(split_feature, threshold, leaf_value,
                                     max_depth, jnp.float32(jnp.inf))
+    has_cat = split_is_cat is not None and cat_words is not None \
+        and cat_words.shape[-1] > 0
 
     def body(scores, tree):
-        sf_t, thr_t, lv_t, tc = tree
-        val = _chain_score(x_t, sf_t, thr_t, lv_t, max_depth)
+        if has_cat:
+            sf_t, thr_t, lv_t, tc, ic, cw = tree
+        else:
+            sf_t, thr_t, lv_t, tc = tree
+            ic = cw = None
+        val = _chain_score(x_t, sf_t, thr_t, lv_t, max_depth,
+                           is_cat=ic, words=cw)
         contrib = val[:, None] * jax.nn.one_hot(tc, n_classes, dtype=lv_t.dtype)
         return scores + contrib, None
 
     init = jnp.zeros((n, n_classes), dtype=jnp.float32)
-    scores, _ = jax.lax.scan(body, init, (sf, thr, lv, tree_class))
+    xs = ((sf, thr, lv, tree_class, split_is_cat, cat_words) if has_cat
+          else (sf, thr, lv, tree_class))
+    scores, _ = jax.lax.scan(body, init, xs)
     return scores
 
 
+def _raw_cat_left(go_left, xf, node, is_cat, words):
+    """Gather-descent membership on raw category ids (identity bin
+    assignment mirrors ops/binning, see raw_to_cat_bin)."""
+    b = raw_to_cat_bin(xf, words.shape[-1])
+    member = packed_member(b, words[node])
+    return jnp.where(is_cat[node], member, go_left)
+
+
 def _predict_raw_gather(x, split_feature, threshold, leaf_value, tree_class,
-                        max_depth: int, n_classes: int):
+                        max_depth: int, n_classes: int,
+                        split_is_cat=None, cat_words=None):
     """O(depth) gather descent for deep trees (NaN routes right here too:
     `xf <= thr` is False for NaN, selecting the right child)."""
     n = x.shape[0]
+    has_cat = split_is_cat is not None and cat_words is not None \
+        and cat_words.shape[-1] > 0
 
     def body(scores, tree):
-        sf, thr, lv, tc = tree
+        if has_cat:
+            sf, thr, lv, tc, ic, cw = tree
+        else:
+            sf, thr, lv, tc = tree
+            ic = cw = None
         node = jnp.zeros(n, dtype=jnp.int32)
         for _ in range(max_depth):
             f = sf[node]
             is_leaf = f < 0
             xf = jnp.take_along_axis(
                 x, jnp.clip(f, 0, x.shape[1] - 1)[:, None], axis=1)[:, 0]
-            child = jnp.where(xf <= thr[node], 2 * node + 1, 2 * node + 2)
+            go_left = xf <= thr[node]
+            if has_cat:
+                go_left = _raw_cat_left(go_left, xf, node, ic, cw)
+            child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
             node = jnp.where(is_leaf, node, child)
         contrib = lv[node][:, None] * jax.nn.one_hot(tc, n_classes, dtype=lv.dtype)
         return scores + contrib, None
 
     init = jnp.zeros((n, n_classes), dtype=jnp.float32)
-    scores, _ = jax.lax.scan(body, init,
-                             (split_feature, threshold, leaf_value, tree_class))
+    xs = ((split_feature, threshold, leaf_value, tree_class, split_is_cat,
+           cat_words) if has_cat
+          else (split_feature, threshold, leaf_value, tree_class))
+    scores, _ = jax.lax.scan(body, init, xs)
     return scores
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
-def predict_leaf_index(x, split_feature, threshold, max_depth: int):
+def predict_leaf_index(x, split_feature, threshold, max_depth: int,
+                       split_is_cat=None, cat_words=None):
     """Per-tree ORIGINAL resting leaf (heap index) per row — the reference's
     predictLeaf output column (lightgbm/booster/LightGBMBooster.scala:346).
     Select-chain descent over propagated node ids; gather fallback deep."""
     n = x.shape[0]
+    has_cat = split_is_cat is not None and cat_words is not None \
+        and cat_words.shape[-1] > 0
     if max_depth <= _SELECT_CHAIN_MAX_DEPTH:
         x_t = x.T
         sf, thr, _, ids = _propagate_leaves(
@@ -500,23 +717,39 @@ def predict_leaf_index(x, split_feature, threshold, max_depth: int):
             ids=_heap_ids(split_feature))
 
         def body(_, tree):
-            sf_t, thr_t, ids_t = tree
-            return None, _chain_score(x_t, sf_t, thr_t, ids_t, max_depth)
+            if has_cat:
+                sf_t, thr_t, ids_t, ic, cw = tree
+            else:
+                sf_t, thr_t, ids_t = tree
+                ic = cw = None
+            return None, _chain_score(x_t, sf_t, thr_t, ids_t, max_depth,
+                                      is_cat=ic, words=cw)
 
-        _, leaves = jax.lax.scan(body, None, (sf, thr, ids))
+        xs = ((sf, thr, ids, split_is_cat, cat_words) if has_cat
+              else (sf, thr, ids))
+        _, leaves = jax.lax.scan(body, None, xs)
         return leaves.T  # (n, T)
 
     def body(_, tree):
-        sf, thr = tree
+        if has_cat:
+            sf, thr, ic, cw = tree
+        else:
+            sf, thr = tree
+            ic = cw = None
         node = jnp.zeros(n, dtype=jnp.int32)
         for _ in range(max_depth):
             f = sf[node]
             is_leaf = f < 0
             xf = jnp.take_along_axis(
                 x, jnp.clip(f, 0, x.shape[1] - 1)[:, None], axis=1)[:, 0]
-            child = jnp.where(xf <= thr[node], 2 * node + 1, 2 * node + 2)
+            go_left = xf <= thr[node]
+            if has_cat:
+                go_left = _raw_cat_left(go_left, xf, node, ic, cw)
+            child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
             node = jnp.where(is_leaf, node, child)
         return None, node
 
-    _, leaves = jax.lax.scan(body, None, (split_feature, threshold))
+    xs = ((split_feature, threshold, split_is_cat, cat_words) if has_cat
+          else (split_feature, threshold))
+    _, leaves = jax.lax.scan(body, None, xs)
     return leaves.T  # (n, T)

@@ -24,18 +24,34 @@ class BinMapper(NamedTuple):
     upper_bounds: np.ndarray   # (n_features, max_bin) f32; +inf padded
     n_bins: np.ndarray         # (n_features,) actual bin count used
     max_bin: int
+    # bool (n_features,) — True columns hold integer category ids and are
+    # binned by IDENTITY (bin = clip(floor(x), 0, max_bin)); None = all
+    # numeric (old artifacts). Reference: categoricalSlotIndexes,
+    # lightgbm/params/LightGBMParams.scala:184-196.
+    categorical: Optional[np.ndarray] = None
 
     @property
     def n_features(self) -> int:
         return self.upper_bounds.shape[0]
 
+    def _cat_mask(self) -> np.ndarray:
+        if self.categorical is None:
+            return np.zeros(self.n_features, bool)
+        return self.categorical
+
 
 def fit_bins(x: np.ndarray, max_bin: int = 255,
-             sample_cnt: int = 200_000, seed: int = 2) -> BinMapper:
+             sample_cnt: int = 200_000, seed: int = 2,
+             categorical_features=()) -> BinMapper:
     """Choose at most max_bin quantile boundaries per feature.
 
     LightGBM samples `bin_construct_sample_cnt` (default 200000) rows to find
     boundaries; we do the same so 1B-row tables don't need a full pass.
+
+    `categorical_features` columns are identity-binned: the value IS the
+    category id, clipped to [0, max_bin] (index categories by frequency —
+    featurize's ValueIndexer does — so rare tails share the overflow bin).
+    NaN maps to the last bin, like the numeric missing-value direction.
     """
     n, f = x.shape
     if n > sample_cnt:
@@ -43,7 +59,16 @@ def fit_bins(x: np.ndarray, max_bin: int = 255,
         x = x[rng.choice(n, sample_cnt, replace=False)]
     ubs = np.full((f, max_bin), np.inf, dtype=np.float32)
     nbins = np.zeros(f, dtype=np.int32)
+    cat_mask = np.zeros(f, dtype=bool)
+    if len(categorical_features):
+        cat_mask[np.asarray(categorical_features, int)] = True
     for j in range(f):
+        if cat_mask[j]:
+            # identity bins; boundaries at k + 0.5 keep even a cat-unaware
+            # threshold consumer piecewise-consistent with the bin ids
+            nbins[j] = max_bin + 1
+            ubs[j] = np.arange(max_bin, dtype=np.float32) + 0.5
+            continue
         col = x[:, j]
         col = col[~np.isnan(col)]
         uniq = np.unique(col)
@@ -62,7 +87,8 @@ def fit_bins(x: np.ndarray, max_bin: int = 255,
         ubs[j, :k] = bounds[:k]
         ubs[j, k:] = np.inf
         nbins[j] = k + 1
-    return BinMapper(upper_bounds=ubs, n_bins=nbins, max_bin=max_bin)
+    return BinMapper(upper_bounds=ubs, n_bins=nbins, max_bin=max_bin,
+                     categorical=cat_mask if cat_mask.any() else None)
 
 
 def apply_bins(mapper: BinMapper, x: np.ndarray) -> np.ndarray:
