@@ -219,6 +219,17 @@ class Booster(NamedTuple):
         if any_cat:
             ic = np.concatenate([a[6], b[6]])
             w16 = max(a[7].shape[2], b[7].shape[2])
+            # widening a booster's membership words would MOVE its
+            # overflow/NaN bin (raw_to_cat_bin's top = w16*16-1), silently
+            # changing how unseen categories route through its trees; only
+            # a side with no categorical nodes can be padded harmlessly
+            both_used = a[6].any() and b[6].any()
+            if both_used and a[7].shape[2] != b[7].shape[2]:
+                raise ValueError(
+                    "cannot merge boosters with different categorical bin "
+                    f"widths ({a[7].shape[2] * 16} vs {b[7].shape[2] * 16} "
+                    "bins): unseen-category/NaN routing would change; "
+                    "retrain the continuation with the same max_bin")
 
             def pw(w):
                 return np.pad(w, ((0, 0), (0, 0), (0, w16 - w.shape[2])))

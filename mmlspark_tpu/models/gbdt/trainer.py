@@ -127,6 +127,37 @@ def _gain_lattice(hg, hh, hc, feature_mask, cfg: TreeConfig,
     return jnp.where(ok, gain, -jnp.inf)
 
 
+def _cat_gain_lattice(hg, hh, hc, feature_mask, cfg: TreeConfig,
+                      parent_g, parent_h, parent_c):
+    """Sorted-set categorical gain lattice, shared by the real split search
+    AND voting-parallel feature polling (which must rank categoricals by
+    this gain, not the ordinal one). Returns (gain (m, C, B) over sorted
+    prefix positions, bin sort order (m, C, B), cat histogram counts)."""
+    B = cfg.n_bins
+    cat_np = np.asarray(cfg.categorical_features, np.int32)
+    # slice, sort bins by gradient statistic, re-search the cumsum lattice
+    cg, chs, ccn = hg[:, cat_np], hh[:, cat_np], hc[:, cat_np]  # (m, C, B)
+    ratio = cg / (chs + cfg.cat_smooth)
+    # empty bins sort LAST so they never occupy prefix positions (unseen
+    # categories at predict time therefore route right, LightGBM's default)
+    ratio = jnp.where(ccn > 0, ratio, jnp.inf)
+    order = jnp.argsort(ratio, axis=-1)                          # (m, C, B)
+    sg = jnp.take_along_axis(cg, order, axis=-1)
+    sh = jnp.take_along_axis(chs, order, axis=-1)
+    sc = jnp.take_along_axis(ccn, order, axis=-1)
+    cfg_cat = cfg._replace(lambda_l2=cfg.lambda_l2 + cfg.cat_l2)
+    gain_cat = _gain_lattice(sg, sh, sc, feature_mask[cat_np], cfg_cat,
+                             parent_g, parent_h, parent_c)
+    # max_cat_threshold (LightGBM): the SMALLER side of a categorical split
+    # may hold at most this many categories — full-prefix scan covers both
+    # scan directions, so cap either side
+    nnz = (ccn > 0).sum(-1, keepdims=True)                       # (m, C, 1)
+    left_cats = jnp.minimum(jnp.arange(B)[None, None, :] + 1, nnz)
+    ok_cat = ((left_cats <= cfg.max_cat_threshold)
+              | (nnz - left_cats <= cfg.max_cat_threshold))
+    return jnp.where(ok_cat, gain_cat, -jnp.inf), order, ccn
+
+
 def _best_splits_for_level(hg, hh, hc, feature_mask, cfg: TreeConfig,
                            parent_g, parent_h, parent_c):
     """Vectorized split search; returns per-node (gain, feature, bin,
@@ -159,27 +190,8 @@ def _best_splits_for_level(hg, hh, hc, feature_mask, cfg: TreeConfig,
     gain_num = _gain_lattice(hg, hh, hc, feature_mask & jnp.asarray(num_mask),
                              cfg, parent_g, parent_h, parent_c)
 
-    # categorical lattice: slice, sort bins by gradient statistic, re-search
-    cg, chs, ccn = hg[:, cat_np], hh[:, cat_np], hc[:, cat_np]  # (m, C, B)
-    ratio = cg / (chs + cfg.cat_smooth)
-    # empty bins sort LAST so they never occupy prefix positions (unseen
-    # categories at predict time therefore route right, LightGBM's default)
-    ratio = jnp.where(ccn > 0, ratio, jnp.inf)
-    order = jnp.argsort(ratio, axis=-1)                          # (m, C, B)
-    sg = jnp.take_along_axis(cg, order, axis=-1)
-    sh = jnp.take_along_axis(chs, order, axis=-1)
-    sc = jnp.take_along_axis(ccn, order, axis=-1)
-    cfg_cat = cfg._replace(lambda_l2=cfg.lambda_l2 + cfg.cat_l2)
-    gain_cat = _gain_lattice(sg, sh, sc, feature_mask[cat_np], cfg_cat,
-                             parent_g, parent_h, parent_c)
-    # max_cat_threshold (LightGBM): the SMALLER side of a categorical split
-    # may hold at most this many categories — full-prefix scan covers both
-    # scan directions, so cap either side
-    nnz = (ccn > 0).sum(-1, keepdims=True)                       # (m, C, 1)
-    left_cats = jnp.minimum(jnp.arange(B)[None, None, :] + 1, nnz)
-    ok_cat = ((left_cats <= cfg.max_cat_threshold)
-              | (nnz - left_cats <= cfg.max_cat_threshold))
-    gain_cat = jnp.where(ok_cat, gain_cat, -jnp.inf)
+    gain_cat, order, ccn = _cat_gain_lattice(hg, hh, hc, feature_mask, cfg,
+                                             parent_g, parent_h, parent_c)
 
     flat = jnp.concatenate([gain_num.reshape(m, -1),
                             gain_cat.reshape(m, -1)], axis=1)
@@ -221,9 +233,24 @@ def _voting_feature_mask(hg, hh, hc, feature_mask, cfg: TreeConfig,
     (split chosen only among voted features).
     """
     local_pg, local_ph, local_pc = hg[:, 0].sum(-1), hh[:, 0].sum(-1), hc[:, 0].sum(-1)
-    gain = _gain_lattice(hg, hh, hc, feature_mask, cfg,
+    cat = tuple(cfg.categorical_features)
+    fmask_num = feature_mask
+    if cat:
+        num_mask = np.ones(cfg.n_features, bool)
+        num_mask[np.asarray(cat, np.int32)] = False
+        fmask_num = feature_mask & jnp.asarray(num_mask)
+    gain = _gain_lattice(hg, hh, hc, fmask_num, cfg,
                          local_pg, local_ph, local_pc)
     per_feat = jnp.max(gain, axis=-1)  # (m, F) local best gain per feature
+    if cat:
+        # categorical features must be voted on their SORTED-set gain, not
+        # the ordinal lattice — otherwise a strong categorical feature with
+        # shuffled effects polls near-zero and is voted out before the real
+        # search ever sees it
+        cat_np = np.asarray(cat, np.int32)
+        gain_cat, _, _ = _cat_gain_lattice(
+            hg, hh, hc, feature_mask, cfg, local_pg, local_ph, local_pc)
+        per_feat = per_feat.at[:, cat_np].set(jnp.max(gain_cat, axis=-1))
     m, F = per_feat.shape
     k = min(top_k, F)
     # local votes: top-k features per node

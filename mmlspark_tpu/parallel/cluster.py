@@ -86,6 +86,30 @@ def process_row_range(n_rows: int, process_id: Optional[int] = None,
     return lo, lo + base + (1 if pid < extra else 0)
 
 
+def padded_process_rows(n_rows: int, mesh, process_id: Optional[int] = None,
+                        process_count: Optional[int] = None):
+    """Equal-block row assignment for `global_array` under ragged counts.
+
+    `make_array_from_process_local_data` needs every process to contribute
+    the SAME block size, divisible by its per-process share of the row
+    shards — a 103-row table over 2 processes x 2 devices cannot ship 52/51.
+    Returns (lo, hi, block): load rows [lo, hi) and zero-pad to `block`;
+    the padded global size is block * process_count. Presence masking of the
+    pad rows is the caller's contract (the GBDT path's zero-weight padding,
+    distributed.py).
+    """
+    import jax
+    from .mesh import DATA_AXIS
+    pid = jax.process_index() if process_id is None else process_id
+    n_proc = jax.process_count() if process_count is None else process_count
+    n_row_shards = mesh.shape[DATA_AXIS]
+    per_proc_shards = max(n_row_shards // n_proc, 1)
+    block = -(-n_rows // n_proc)                      # ceil
+    block = -(-block // per_proc_shards) * per_proc_shards
+    lo = min(pid * block, n_rows)
+    return lo, min(lo + block, n_rows), block
+
+
 def global_array(mesh, local_rows: np.ndarray, axis_name: str = None):
     """Assemble a row-sharded global jax.Array from THIS process's rows.
 

@@ -36,6 +36,20 @@ def test_global_array_row_sharded():
     assert len(g.sharding.device_set) == 8
 
 
+def test_padded_process_rows_even_blocks():
+    from mmlspark_tpu.parallel import padded_process_rows
+    mesh = data_mesh(8)
+    # fake 2-process grid over the 8-shard mesh: blocks equal, divisible by
+    # the per-process shard share (4), covering all rows
+    spans = [padded_process_rows(103, mesh, pid, 2) for pid in range(2)]
+    blocks = {b for _, _, b in spans}
+    assert len(blocks) == 1
+    block = blocks.pop()
+    assert block % 4 == 0 and 2 * block >= 103
+    assert spans[0][0] == 0 and spans[1][1] == 103
+    assert spans[0][1] == min(block, 103) == spans[1][0]
+
+
 def test_barrier_and_broadcast_single_process():
     barrier("test")  # must not hang
     out = broadcast_from_leader(np.array([1, 2, 3]))

@@ -176,6 +176,50 @@ def test_distributed_categorical_matches_single():
                                atol=1e-5)
 
 
+def test_numpy_membership_mirror_matches_jax():
+    """booster._cat_member_np (the SHAP path's host oracle) must stay
+    bit-identical to trainer.raw_to_cat_bin + trainer.packed_member on
+    adversarial inputs: NaN, negatives, overflow ids, fractional values."""
+    from mmlspark_tpu.models.gbdt.booster import _cat_member_np
+    rng = np.random.default_rng(3)
+    for w16 in (1, 4, 16):
+        n = 300
+        xf = np.concatenate([
+            rng.integers(-10, w16 * 16 + 40, n - 44).astype(np.float32),
+            rng.normal(scale=100, size=40).astype(np.float32),
+            np.array([np.nan, -0.4, 0.49, 0.51], np.float32)])
+        words = rng.integers(0, 1 << 16, size=(len(xf), w16)).astype(np.int32)
+        got = _cat_member_np(xf, words)
+        import jax.numpy as jnp
+        b = trainer.raw_to_cat_bin(jnp.asarray(xf), w16)
+        want = np.asarray(trainer.packed_member(b, jnp.asarray(words)))
+        np.testing.assert_array_equal(got, want, err_msg=f"w16={w16}")
+
+
+def test_voting_parallel_finds_categorical_splits():
+    """PV-tree voting must rank categorical features by their sorted-set
+    gain — a shuffled-effect categorical polls ~zero ordinal gain and would
+    otherwise be voted out before the real search runs."""
+    from mmlspark_tpu.models.gbdt.distributed import fit_booster_distributed
+    x, y = _cat_data(n=1600)
+    p = BoostParams(objective="binary", num_iterations=4, max_depth=3,
+                    max_bin=63, categorical_features=(2,), min_data_in_leaf=5)
+    bv, _, _ = fit_booster_distributed(x, y, p, parallelism="voting_parallel",
+                                       top_k=1)
+    assert bv.split_is_cat.any()
+    assert (bv.split_feature == 2).any()
+
+
+def test_merge_rejects_mismatched_cat_widths():
+    x, y = _cat_data(n=600)
+    kw = dict(objective="binary", num_iterations=2, max_depth=3,
+              categorical_features=(2,), min_data_in_leaf=5)
+    b63, _, _ = fit_booster(x, y, BoostParams(max_bin=63, **kw))
+    b255, _, _ = fit_booster(x, y, BoostParams(max_bin=255, **kw))
+    with pytest.raises(ValueError, match="categorical bin widths"):
+        b63.merge(b255)
+
+
 def test_estimator_categorical_slot_params():
     from mmlspark_tpu.core import Table
     from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
