@@ -5,6 +5,9 @@ from .http import (HTTPTransformer, SimpleHTTPTransformer, JSONInputParser,
                    CustomOutputParser, PartitionConsolidator, HTTPRequest,
                    HTTPResponse)
 from .serving import ServingServer, serve_pipeline, ServingQuery
+from .registry import (RegistryClient, ServiceInfo, ServiceRegistry,
+                       list_services, report_server_to_registry,
+                       start_distributed_serving)
 from .shared import (ForwardedPort, SharedVariable, forward_port_to_remote,
                      shared_singleton)
 
@@ -12,5 +15,8 @@ __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
            "JSONOutputParser", "StringOutputParser", "CustomInputParser",
            "CustomOutputParser", "PartitionConsolidator", "HTTPRequest",
            "HTTPResponse", "ServingServer", "serve_pipeline", "ServingQuery",
+           "RegistryClient", "ServiceInfo", "ServiceRegistry",
+           "list_services", "report_server_to_registry",
+           "start_distributed_serving",
            "SharedVariable", "shared_singleton", "ForwardedPort",
            "forward_port_to_remote"]

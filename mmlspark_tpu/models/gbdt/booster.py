@@ -221,14 +221,16 @@ class Booster(NamedTuple):
             w16 = max(a[7].shape[2], b[7].shape[2])
             # widening a booster's membership words would MOVE its
             # overflow/NaN bin (raw_to_cat_bin's top = w16*16-1), silently
-            # changing how unseen categories route through its trees; only
-            # a side with no categorical nodes can be padded harmlessly
-            both_used = a[6].any() and b[6].any()
-            if both_used and a[7].shape[2] != b[7].shape[2]:
+            # changing how unseen categories route through its trees; a side
+            # can be padded harmlessly only if it has NO categorical nodes
+            def _unsafe(side):
+                return side[7].shape[2] < w16 and side[6].any()
+            if _unsafe(a) or _unsafe(b):
                 raise ValueError(
                     "cannot merge boosters with different categorical bin "
                     f"widths ({a[7].shape[2] * 16} vs {b[7].shape[2] * 16} "
-                    "bins): unseen-category/NaN routing would change; "
+                    "bins) when the narrower one contains categorical "
+                    "splits: unseen-category/NaN routing would change; "
                     "retrain the continuation with the same max_bin")
 
             def pw(w):

@@ -218,6 +218,18 @@ def test_merge_rejects_mismatched_cat_widths():
     b255, _, _ = fit_booster(x, y, BoostParams(max_bin=255, **kw))
     with pytest.raises(ValueError, match="categorical bin widths"):
         b63.merge(b255)
+    # asymmetric hazard: the narrower side HAS cat nodes, the wider side
+    # carries (unused) wide membership words — padding would still move
+    # b63's overflow bin, so this must refuse too
+    b255_nocat = b255._replace(
+        split_is_cat=np.zeros_like(b255.split_is_cat),
+        split_feature=np.where(b255.split_is_cat, -1, b255.split_feature))
+    with pytest.raises(ValueError, match="categorical bin widths"):
+        b63.merge(b255_nocat)
+    # width-matched continuation still merges fine
+    b63b, _, _ = fit_booster(x, y, BoostParams(max_bin=63, **kw))
+    merged = b63.merge(b63b)
+    assert merged.n_trees == 4
 
 
 def test_estimator_categorical_slot_params():

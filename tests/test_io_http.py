@@ -222,7 +222,101 @@ def test_serving_continuous_latency():
             lat.append(time.perf_counter() - t0)
         p50 = sorted(lat)[len(lat) // 2] * 1000
         print(f"serving p50 latency: {p50:.2f} ms")
-        assert p50 < 100, f"p50 {p50:.1f}ms unreasonably slow"
+        # the reference claims sub-ms executor-local; localhost HTTP must at
+        # least hold single-digit ms or the claim is dead (round-2 verdict
+        # weak #3: the old 100 ms bound enforced nothing)
+        assert p50 < 5, f"p50 {p50:.2f}ms busts the continuous-mode budget"
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_serving_concurrent_throughput():
+    """16 concurrent clients hammering one server: prints sustained req/s,
+    p50 and p99, and enforces floor/ceiling sanity (round-2 verdict weak
+    #3 asked for a concurrent number, not a single-client loop)."""
+    server = ServingServer(num_partitions=4).start()
+    q = ServingQuery(server, lambda bodies: [{"v": 1} for _ in bodies],
+                     mode="continuous", poll_timeout=0.001).start()
+    n_clients, per_client = 16, 25
+    lat, errors = [], []
+    lock = threading.Lock()
+
+    def client(cid):
+        for i in range(per_client):
+            t0 = time.perf_counter()
+            try:
+                out = _post(server.address, {"x": cid * 1000 + i}, timeout=20)
+                assert out == {"v": 1}
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(e)
+
+    try:
+        _post(server.address, {"warm": 1})
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors[:3]
+        assert len(lat) == n_clients * per_client
+        lat.sort()
+        p50 = lat[len(lat) // 2] * 1000
+        p99 = lat[int(len(lat) * 0.99)] * 1000
+        rps = len(lat) / wall
+        print(f"serving 16-client: {rps:.0f} req/s, "
+              f"p50 {p50:.2f} ms, p99 {p99:.2f} ms")
+        assert rps > 200, f"{rps:.0f} req/s under concurrent load"
+        assert p99 < 250, f"p99 {p99:.1f}ms"
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_poison_row_isolated_from_batch():
+    """One malformed request inside a batch must 502 ALONE after bounded
+    replay — its batch-mates still answer 200 (reference: ServingUDFs
+    row-level errorCol short-circuit; round-2 verdict weak #9)."""
+    server = ServingServer(num_partitions=1, reply_timeout=30).start()
+
+    def transform(bodies):
+        rows = [json.loads(b) for b in bodies]
+        if any(r.get("poison") for r in rows) and len(rows) > 1:
+            raise ValueError("batch blew up")
+        if rows and rows[0].get("poison"):
+            raise ValueError("poison row")
+        return [{"ok": r["v"]} for r in rows]
+
+    # long poll window so all three requests land in ONE batch
+    q = ServingQuery(server, transform, max_batch=8, poll_timeout=1.0)
+    results = {}
+
+    def send(key, payload):
+        try:
+            results[key] = ("ok", _post(server.address, payload, timeout=30))
+        except urllib.error.HTTPError as e:
+            results[key] = ("err", e.code, json.loads(e.read()))
+
+    threads = [threading.Thread(target=send, args=(k, p)) for k, p in
+               [("a", {"v": 1}), ("bad", {"poison": True}), ("b", {"v": 2})]]
+    try:
+        for th in threads:
+            th.start()
+        time.sleep(0.3)   # let all three enqueue into the same epoch
+        q.start()
+        for th in threads:
+            th.join()
+        assert results["a"] == ("ok", {"ok": 1})
+        assert results["b"] == ("ok", {"ok": 2})
+        kind, code, body = results["bad"]
+        assert kind == "err" and code == 502
+        assert "poison" in body["error"]
     finally:
         q.stop()
         server.stop()

@@ -1,0 +1,120 @@
+"""Cross-host serving registry (reference: HTTPSourceV2.scala:133-194
+DriverServiceUtils + :460-468 reportServerToDriver/ServiceInfo).
+Single-process coverage here; the real 2-process composition (leader
+registry + per-process servers + worker-kill replay) lives in
+tests/test_multiprocess.py::test_distributed_serving_two_processes."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io import (RegistryClient, ServiceRegistry, ServingQuery,
+                             ServingServer, list_services,
+                             report_server_to_registry)
+
+
+def _echo_query(server, tag):
+    def transform(bodies):
+        return [{"echo": json.loads(b)["x"], "tag": tag} for b in bodies]
+    return ServingQuery(server, transform, mode="continuous").start()
+
+
+@pytest.fixture
+def registry():
+    reg = ServiceRegistry().start()
+    yield reg
+    reg.stop()
+
+
+def test_register_list_unregister(registry):
+    report_server_to_registry(registry.address, "svc", "127.0.0.1", 7001,
+                              process_id=0)
+    report_server_to_registry(registry.address, "svc", "127.0.0.1", 7002,
+                              process_id=1)
+    report_server_to_registry(registry.address, "other", "127.0.0.1", 7003)
+    svcs = list_services(registry.address, "svc")
+    assert sorted(s.port for s in svcs) == [7001, 7002]
+    assert all(s.address.startswith("http://127.0.0.1:") for s in svcs)
+    # unregister removes one endpoint only
+    req = urllib.request.Request(
+        registry.address + "/unregister",
+        data=json.dumps({"name": "svc", "host": "127.0.0.1",
+                         "port": 7001}).encode(), method="POST")
+    urllib.request.urlopen(req)
+    assert [s.port for s in list_services(registry.address, "svc")] == [7002]
+    # bad paths/payloads answer with errors, not stack traces
+    with urllib.request.urlopen(registry.address + "/services") as r:
+        assert r.status == 200
+    req = urllib.request.Request(registry.address + "/register",
+                                 data=b"{not json", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_round_robin_and_failover(registry):
+    s1 = ServingServer(num_partitions=1).start()
+    s2 = ServingServer(num_partitions=1).start()
+    q1 = _echo_query(s1, "a")
+    q2 = _echo_query(s2, "b")
+    for s in (s1, s2):
+        host, port = s._httpd.server_address[:2]
+        report_server_to_registry(registry.address, "echo", host, port)
+    client = RegistryClient(registry.address, "echo")
+    tags = set()
+    for i in range(6):
+        status, body = client.post(json.dumps({"x": i}).encode())
+        assert status == 200
+        reply = json.loads(body)
+        assert reply["echo"] == i
+        tags.add(reply["tag"])
+    assert tags == {"a", "b"}  # traffic really round-robins both servers
+
+    # kill server b: the client must fail over and keep answering from a
+    q2.stop()
+    s2.stop()
+    for i in range(4):
+        status, body = client.post(json.dumps({"x": 10 + i}).encode())
+        assert status == 200
+        assert json.loads(body)["tag"] == "a"
+    q1.stop()
+    s1.stop()
+
+
+def test_http_error_returned_not_failed_over(registry):
+    """A 502 from a healthy server is an ANSWER, not a death: the client
+    must return it without re-posting the request to other servers (which
+    would re-execute it) or marking the server dead."""
+    s1 = ServingServer(num_partitions=1).start()
+    calls = []
+
+    def transform(bodies):
+        calls.append(len(bodies))
+        raise ValueError("always poison")
+
+    q = ServingQuery(s1, transform, mode="continuous", poll_timeout=0.001)
+    q.MAX_REPLAYS = 0  # fail fast to the row-level 502 path
+    q.start()
+    host, port = s1._httpd.server_address[:2]
+    report_server_to_registry(registry.address, "poison", host, port)
+    client = RegistryClient(registry.address, "poison")
+    try:
+        status, body = client.post(json.dumps({"x": 1}).encode())
+        assert status == 502
+        assert "poison" in json.loads(body)["error"]
+        # the server stays in rotation: a second request still reaches it
+        status2, _ = client.post(json.dumps({"x": 2}).encode())
+        assert status2 == 502
+    finally:
+        q.stop()
+        s1.stop()
+
+
+def test_no_live_servers_is_clear_error(registry):
+    client_err = None
+    try:
+        RegistryClient(registry.address, "ghost").post(b"{}")
+    except RuntimeError as e:
+        client_err = str(e)
+    assert client_err and "ghost" in client_err
