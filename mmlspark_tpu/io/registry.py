@@ -66,6 +66,8 @@ class _RegistryHandler(BaseHTTPRequestHandler):
             reg._put(info)
             return self._json(200, {"registered": info.address})
         if self.path == "/unregister":
+            if not isinstance(body, dict):
+                return self._json(400, {"error": "body must be an object"})
             reg._remove(body.get("name", ""), body.get("host", ""),
                         body.get("port", 0))
             return self._json(200, {"ok": True})
@@ -151,6 +153,8 @@ class RegistryClient:
     load-balancer role the reference's ServiceInfo export feeds. Dead
     servers drop out of rotation (and are retried on the next refresh)."""
 
+    _MAX_ATTEMPTS = 16  # failover ceiling per post()
+
     def __init__(self, registry_address: str, name: str,
                  refresh_every: int = 64, timeout: float = 30.0):
         self.registry_address = registry_address
@@ -173,10 +177,20 @@ class RegistryClient:
     def _next_target(self):
         with self._lock:
             live = [t for t in self._targets if t.address not in self._dead]
+        if not live:
+            # every target is marked dead: re-poll the registry NOW (the
+            # periodic refresh keys off _count, which stops advancing once
+            # this raises — without this the client would wedge forever
+            # even after servers re-register)
+            self.refresh()
+            with self._lock:
+                live = [t for t in self._targets
+                        if t.address not in self._dead]
             if not live:
                 raise RuntimeError(
                     f"no live servers for service {self.name!r} "
                     f"(registry {self.registry_address})")
+        with self._lock:
             t = live[self._count % len(live)]
             self._count += 1
             return t
@@ -192,10 +206,12 @@ class RegistryClient:
                 self.refresh()
             except Exception:  # noqa: BLE001 - keep serving from last list
                 pass
-        with self._lock:
-            n_live = max(len(self._targets) - len(self._dead), 1)
+        # bounded attempts rather than a pre-computed live count: marking a
+        # server dead (or an all-dead refresh inside _next_target) changes
+        # the rotation mid-call, and a stale budget would give up with
+        # untried servers still live
         last_err = None
-        for _ in range(n_live):
+        for _ in range(self._MAX_ATTEMPTS):
             t = self._next_target()
             req = urllib.request.Request(
                 t.address + path, data=body,
@@ -214,11 +230,28 @@ class RegistryClient:
         raise RuntimeError(f"every server for {self.name!r} failed: {last_err}")
 
 
+def _advertised_host(bind_host: str, advertise_host) -> str:
+    """The address other machines should dial. A wildcard/loopback bind is
+    reachable only locally — advertise the host's routable address instead
+    (reference: DriverServiceUtils.getDriverHost resolves the driver's
+    non-loopback address for exactly this reason)."""
+    import socket
+    if advertise_host:
+        return advertise_host
+    if bind_host in ("0.0.0.0", "::", ""):
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+    return bind_host
+
+
 def start_distributed_serving(transform_fn, name: str = "serving",
                               host: str = "127.0.0.1",
                               num_partitions: int = 1,
                               mode: str = "microbatch",
-                              registry_port: int = 0):
+                              registry_port: int = 0,
+                              advertise_host=None):
     """Every process of the jax.distributed job serves; the leader also runs
     the registry. Returns (registry_or_None, server, query, registry_address)
     — registry is non-None only on process 0.
@@ -237,10 +270,13 @@ def start_distributed_serving(transform_fn, name: str = "serving",
 
     import jax
     pid = jax.process_index()
+    pub_host = _advertised_host(host, advertise_host)
     registry = None
     if pid == 0:
         registry = ServiceRegistry(host=host, port=registry_port).start()
-        addr = registry.address
+        # broadcast the ROUTABLE address, not the bind address — a
+        # wildcard/loopback bind would point every other host at itself
+        addr = f"http://{pub_host}:{registry._httpd.server_address[1]}"
     else:
         addr = ""
     # fixed-width byte broadcast over the device fabric (uint8 payload)
@@ -253,8 +289,8 @@ def start_distributed_serving(transform_fn, name: str = "serving",
     server = ServingServer(host=host, port=0,
                            num_partitions=num_partitions).start()
     query = ServingQuery(server, transform_fn, mode=mode).start()
-    s_host, s_port = server._httpd.server_address[:2]
-    report_server_to_registry(registry_address, name, s_host, s_port,
+    s_port = server._httpd.server_address[1]
+    report_server_to_registry(registry_address, name, pub_host, s_port,
                               process_id=pid, num_partitions=num_partitions)
     cluster.barrier(f"serving_up_{name}")
     return registry, server, query, registry_address

@@ -1,0 +1,213 @@
+"""Pipeline-parallel LM training: GPipe microbatching over a `pipe` mesh axis.
+
+The last named parallelism strategy from SURVEY §2.10 (TP: lm_training.py,
+CP: parallel/ring_attention.py — PP completes the set). TPU-native design:
+
+- The transformer's layers are STACKED on a leading axis and sharded over
+  the `pipe` mesh axis — each device materializes only its stage's layers
+  (true memory scaling, the reason PP exists).
+- One `shard_map` runs the classic GPipe schedule: at tick t, stage s
+  computes microbatch t-s; activations hop stage s -> s+1 through ONE
+  `lax.ppermute` per tick (neighbor traffic rides ICI).
+- Only the FORWARD schedule is written. `jax.value_and_grad` through the
+  ppermute gives the reverse schedule for free — the transpose of a
+  ppermute is the reverse ppermute, so backward activations flow s+1 -> s
+  with no hand-written bubble bookkeeping.
+- Composable with dp: mesh ("data", "pipe"); the batch shards over `data`,
+  every data-slice runs its own pipeline, gradients pmean over `data`.
+
+The reference has no sequence models at all (SURVEY §5) — this file exists
+because long-context/distributed training is first-class in the TPU build,
+not because a Scala counterpart does.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .transformer import init_transformer
+
+
+def _stack_layers(layers: list) -> dict:
+    """List of per-layer param dicts -> one dict with (L, ...) leaves."""
+    import jax
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *layers)
+
+
+def _block(x, lp, h: int, dh: int):
+    """One transformer block on a (S, d) sequence — the same math as
+    transformer_apply's loop body (dense causal attention), kept in lockstep
+    so pipelined and unpipelined losses agree bit-for-bit up to reduction
+    order (parity-tested)."""
+    import jax
+    import jax.numpy as jnp
+    from ...parallel.ring_attention import reference_attention
+    from .transformer import _layer_norm
+
+    seq, d = x.shape
+    y = _layer_norm(x, lp["ln1"])
+    q = (y @ lp["wq"]).reshape(seq, h, dh)
+    k = (y @ lp["wk"]).reshape(seq, h, dh)
+    v = (y @ lp["wv"]).reshape(seq, h, dh)
+    a = reference_attention(q, k, v, causal=True)
+    x = x + a.reshape(seq, d) @ lp["wo"]
+    y = _layer_norm(x, lp["ln2"])
+    return x + jax.nn.gelu(y @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+
+
+class PipelinedLMTrainer:
+    """dp x pp trainer: params live stage-sharded, one jitted train step.
+
+    Usage:
+        mesh = grid_mesh((dp, pp), (DATA_AXIS, PIPE_AXIS))
+        t = PipelinedLMTrainer(vocab, mesh=mesh, n_microbatches=4, ...)
+        loss = t.step(tokens)   # (B, S) int32; B % (dp * n_microbatches) == 0
+    """
+
+    def __init__(self, vocab_size: int, mesh=None, n_microbatches: int = 4,
+                 d_model: int = 128, n_heads: int = 8, n_layers: int = 4,
+                 d_ff: int = 256, max_len: int = 512, lr: float = 1e-3,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ...parallel import DATA_AXIS, PIPE_AXIS, grid_mesh
+        from ...parallel.shard import shard_map
+
+        if mesh is None:
+            n = jax.device_count()
+            pp = max(d for d in range(1, n_layers + 1)
+                     if n_layers % d == 0 and n % d == 0)
+            mesh = grid_mesh((n // pp, pp), (DATA_AXIS, PIPE_AXIS))
+        n_stages = mesh.shape[PIPE_AXIS]
+        if n_layers % n_stages:
+            raise ValueError(
+                f"n_layers ({n_layers}) must divide by the pipe axis "
+                f"({n_stages}) so every stage holds the same layer count")
+        self.mesh = mesh
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+
+        raw = init_transformer(vocab_size, d_model, n_heads, n_layers,
+                               d_ff, max_len, seed)
+        self.meta = raw.pop("meta")
+        params = {
+            "layers": _stack_layers(raw["layers"]),   # leaves (L, ...)
+            "embed": raw["embed"], "pos": raw["pos"],
+            "final_ln": raw["final_ln"],
+        }
+
+        layer_specs = jax.tree_util.tree_map(
+            lambda _: P(PIPE_AXIS), params["layers"])
+        self._param_specs = {
+            "layers": layer_specs,
+            "embed": P(), "pos": P(), "final_ln":
+                jax.tree_util.tree_map(lambda _: P(), params["final_ln"]),
+        }
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), self._param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        self.params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(jnp.asarray(a), s), params, shardings)
+        self._opt = optax.adam(lr)
+        self.opt_state = self._opt.init(self.params)
+        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+
+        h = self.meta["n_heads"]
+        d = self.meta["d_model"]
+        dh = d // h
+        M = n_microbatches
+        S_P = n_stages
+        opt = self._opt
+
+        def device_loss(p, tokens):
+            """Per-device GPipe forward; returns the replicated global loss.
+            p["layers"] leaves are this stage's (L/P, ...) slice."""
+            s_idx = jax.lax.axis_index(PIPE_AXIS)
+            b_loc, S = tokens.shape
+            mb = b_loc // M
+            mbs = tokens.reshape(M, mb, S)
+
+            def apply_stage(x):      # (mb, S, d) through this stage's layers
+                def one_layer(h_x, lp):
+                    return jax.vmap(lambda xx: _block(xx, lp, h, dh))(h_x), None
+                x, _ = jax.lax.scan(one_layer, x, p["layers"])
+                return x
+
+            def embed_mb(tok):       # (mb, S) -> (mb, S, d)
+                return p["embed"][tok] + p["pos"][:S]
+
+            def mb_loss(y, tok):     # final-stage head on (mb, S, d)
+                from .transformer import _layer_norm
+                z = _layer_norm(y, p["final_ln"])
+                logits = z @ p["embed"].T
+                logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+                tgt = tok[:, 1:]
+                nll = -jnp.take_along_axis(logp, tgt[..., None],
+                                           axis=-1)[..., 0]
+                return nll.mean()
+
+            def tick(carry, t):
+                act, acc = carry
+                x0 = embed_mb(mbs[jnp.clip(t, 0, M - 1)])
+                x_in = jnp.where(s_idx == 0, x0, act)
+                y = apply_stage(x_in)
+                out_idx = t - (S_P - 1)
+                valid = ((out_idx >= 0) & (out_idx < M)
+                         & (s_idx == S_P - 1))
+                tok_out = mbs[jnp.clip(out_idx, 0, M - 1)]
+                acc = acc + jnp.where(valid, mb_loss(y, tok_out), 0.0)
+                act = jax.lax.ppermute(
+                    y, PIPE_AXIS,
+                    [(i, (i + 1) % S_P) for i in range(S_P)])
+                return (act, acc), None
+
+            act0 = jnp.zeros((mb, S, d), jnp.float32)
+            (_, acc), _ = jax.lax.scan(tick, (act0, jnp.float32(0.0)),
+                                       jnp.arange(M + S_P - 1))
+            # loss lives on the last stage; replicate over pipe, average dp
+            loss = jax.lax.psum(acc, PIPE_AXIS) / M
+            return jax.lax.pmean(loss, DATA_AXIS)
+
+        def fwd_bwd(p, tokens):
+            loss, grads = jax.value_and_grad(device_loss)(p, tokens)
+            # dp gradient all-reduce; stage-sharded layer grads stay local
+            # to their pipe coordinate, replicated leaves also pmean over
+            # pipe (each stage computed grads for its own use of them)
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, DATA_AXIS), grads)
+            rep = {k: jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, PIPE_AXIS), grads[k])
+                for k in ("embed", "pos", "final_ln")}
+            grads = {**grads, **rep}
+            return loss, grads
+
+        mapped = shard_map(
+            fwd_bwd, mesh=mesh,
+            in_specs=(self._param_specs, P(DATA_AXIS, None)),
+            out_specs=(P(), self._param_specs), check_rep=False)
+
+        @jax.jit
+        def train_step(params, opt_state, tokens):
+            loss, grads = mapped(params, tokens)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._step = train_step
+
+    def step(self, tokens: np.ndarray) -> float:
+        """One dp x pp update; returns the batch loss."""
+        import jax
+        import jax.numpy as jnp
+        from ...parallel import DATA_AXIS
+        dp = self.mesh.shape[DATA_AXIS]
+        B = tokens.shape[0]
+        if B % (dp * self.n_microbatches):
+            raise ValueError(
+                f"batch {B} must divide by dp*microbatches = "
+                f"{dp * self.n_microbatches}")
+        tok = jax.device_put(jnp.asarray(tokens, jnp.int32),
+                             self._batch_sharding)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, tok)
+        return float(loss)

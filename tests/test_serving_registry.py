@@ -118,3 +118,46 @@ def test_no_live_servers_is_clear_error(registry):
     except RuntimeError as e:
         client_err = str(e)
     assert client_err and "ghost" in client_err
+
+
+def test_client_recovers_after_all_dead(registry):
+    """A client whose every target died must re-poll the registry on the
+    next post — a restarted/re-registered server gets traffic again
+    instead of the client wedging on 'no live servers' forever."""
+    s1 = ServingServer(num_partitions=1).start()
+    q1 = _echo_query(s1, "a")
+    host, port = s1._httpd.server_address[:2]
+    report_server_to_registry(registry.address, "reborn", host, port)
+    client = RegistryClient(registry.address, "reborn")
+    status, _ = client.post(json.dumps({"x": 1}).encode())
+    assert status == 200
+    q1.stop()
+    s1.stop()
+    with pytest.raises(RuntimeError):
+        client.post(json.dumps({"x": 2}).encode())
+    # server comes back on a NEW port and re-registers
+    s2 = ServingServer(num_partitions=1).start()
+    q2 = _echo_query(s2, "b")
+    host2, port2 = s2._httpd.server_address[:2]
+    report_server_to_registry(registry.address, "reborn", host2, port2)
+    try:
+        status, body = client.post(json.dumps({"x": 3}).encode())
+        assert status == 200 and json.loads(body)["tag"] == "b"
+    finally:
+        q2.stop()
+        s2.stop()
+
+
+def test_unregister_rejects_non_object_body(registry):
+    req = urllib.request.Request(registry.address + "/unregister",
+                                 data=b"[1,2]", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_advertised_host_resolution():
+    from mmlspark_tpu.io.registry import _advertised_host
+    assert _advertised_host("10.0.0.7", None) == "10.0.0.7"
+    assert _advertised_host("0.0.0.0", None) not in ("0.0.0.0", "::", "")
+    assert _advertised_host("0.0.0.0", "tpu-host-3") == "tpu-host-3"
