@@ -89,8 +89,9 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
     w = rng.normal(size=n_feat)
     y = (x @ w + rng.normal(scale=0.5, size=n_rows) > 0).astype(np.float32)
     params = BoostParams(objective="binary", num_iterations=n_iters,
-                         num_leaves=31, max_depth=5, max_bin=max_bin,
-                         min_data_in_leaf=20)
+                         num_leaves=31,
+                         max_depth=int(os.environ.get("BENCH_DEPTH", 5)),
+                         max_bin=max_bin, min_data_in_leaf=20)
     # stage data on device once (dataset binning + H2D copy are one-time
     # costs in any real pipeline and the dev tunnel's slow H2D link would
     # otherwise dominate); the timed region is the training loop itself
@@ -149,6 +150,24 @@ def main():
 
     res, booster, x = run_shape(N_ROWS, N_FEATURES, 63, N_ITERS, copy_gbps,
                                 "gbdt_train_rows_iters_per_sec")
+
+    if os.environ.get("BENCH_MODE") == "shap":
+        # exact path-dependent TreeSHAP on device (shap_device.py): the
+        # host DFS oracle is O(4^depth) Python recursion per tree — at this
+        # scale it is not runnable; the device number is the deliverable
+        import time as _t
+        n_shap = int(os.environ.get("BENCH_SHAP_ROWS", 100_000))
+        t0 = _t.time()
+        phi = booster.feature_contributions(x[:n_shap], backend="device")
+        dt = _t.time() - t0
+        add_err = float(np.abs(phi.sum(1)
+                               - booster.raw_score(x[:n_shap])[:, 0]).max())
+        print(json.dumps({
+            "metric": "gbdt_shap_rows_per_sec", "value": round(n_shap / dt, 1),
+            "unit": "rows/s", "vs_baseline": 0.0,
+            "trees": booster.n_trees, "depth": booster.max_depth,
+            "additivity_err": add_err}))
+        return
 
     if os.environ.get("BENCH_MODE") == "predict":
         # inference throughput (VERDICT weak #4 asked for this number):

@@ -5,6 +5,7 @@ from .http import (HTTPTransformer, SimpleHTTPTransformer, JSONInputParser,
                    CustomOutputParser, PartitionConsolidator, HTTPRequest,
                    HTTPResponse)
 from .serving import ServingServer, serve_pipeline, ServingQuery
+from .streaming import FileStreamQuery, FileStreamSource
 from .registry import (RegistryClient, ServiceInfo, ServiceRegistry,
                        list_services, report_server_to_registry,
                        start_distributed_serving)
@@ -18,5 +19,6 @@ __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
            "RegistryClient", "ServiceInfo", "ServiceRegistry",
            "list_services", "report_server_to_registry",
            "start_distributed_serving",
+           "FileStreamQuery", "FileStreamSource",
            "SharedVariable", "shared_singleton", "ForwardedPort",
            "forward_port_to_remote"]

@@ -175,22 +175,11 @@ class RegistryClient:
             self._dead.clear()
 
     def _next_target(self):
+        """Pick the next live target; None when every target is dead."""
         with self._lock:
             live = [t for t in self._targets if t.address not in self._dead]
-        if not live:
-            # every target is marked dead: re-poll the registry NOW (the
-            # periodic refresh keys off _count, which stops advancing once
-            # this raises — without this the client would wedge forever
-            # even after servers re-register)
-            self.refresh()
-            with self._lock:
-                live = [t for t in self._targets
-                        if t.address not in self._dead]
             if not live:
-                raise RuntimeError(
-                    f"no live servers for service {self.name!r} "
-                    f"(registry {self.registry_address})")
-        with self._lock:
+                return None
             t = live[self._count % len(live)]
             self._count += 1
             return t
@@ -206,13 +195,27 @@ class RegistryClient:
                 self.refresh()
             except Exception:  # noqa: BLE001 - keep serving from last list
                 pass
-        # bounded attempts rather than a pre-computed live count: marking a
-        # server dead (or an all-dead refresh inside _next_target) changes
-        # the rotation mid-call, and a stale budget would give up with
-        # untried servers still live
+        # bounded attempts rather than a pre-computed live count (marking a
+        # server dead changes the rotation mid-call); at most ONE all-dead
+        # registry re-poll per post — re-polling every iteration would
+        # resurrect a crashed-but-still-registered server 16 times and turn
+        # one dead host into minutes of connect timeouts
         last_err = None
+        refreshed = False
         for _ in range(self._MAX_ATTEMPTS):
             t = self._next_target()
+            if t is None:
+                if refreshed:
+                    break
+                refreshed = True
+                try:
+                    self.refresh()   # a re-registered server re-enters here
+                except Exception as e:  # noqa: BLE001
+                    last_err = last_err or e
+                    break
+                t = self._next_target()
+                if t is None:
+                    break
             req = urllib.request.Request(
                 t.address + path, data=body,
                 headers={"Content-Type": content_type}, method="POST")
@@ -227,6 +230,10 @@ class RegistryClient:
                 last_err = e
                 with self._lock:
                     self._dead.add(t.address)
+        if last_err is None:
+            raise RuntimeError(
+                f"no live servers for service {self.name!r} "
+                f"(registry {self.registry_address})")
         raise RuntimeError(f"every server for {self.name!r} failed: {last_err}")
 
 

@@ -1,0 +1,192 @@
+"""Streaming file ingestion: directory watch -> epoch-batched Tables.
+
+Role-equivalent to the reference's streaming-capable sources —
+io/binary/BinaryFileFormat.scala (a Spark FileFormat, hence usable under
+readStream) and the epoch mechanics of DistributedHTTPSource — composed
+with the SAME commit/replay contract io/serving.py uses:
+
+- `get_batch()` returns (epoch, Table|None) of data discovered since the
+  last commit. The batch is CACHED until `commit(epoch)`: a consumer that
+  dies mid-batch re-reads the identical Table on its next poll (epoch
+  replay), no matter how much new data arrived meanwhile.
+- `commit(epoch)` advances the source's durable position (per-file byte
+  offsets / seen-file set) — positions move ONLY on commit, exactly like a
+  streaming checkpoint.
+
+Two modes:
+- "binary": every NEW file under the glob becomes a (path, bytes) row
+  (BinaryFileFormat's reader shape, incremental).
+- "csv": files are TAILED by byte offset — appended rows stream in as they
+  are written; only complete (newline-terminated) lines are consumed, so a
+  writer mid-line never produces a torn row. All files share the schema of
+  the first header seen.
+
+`FileStreamQuery` is the pull loop: batch -> transform -> sink -> commit,
+with bounded replay on failure (same recovery shape as ServingQuery).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core import Table
+
+
+class FileStreamSource:
+    """Incremental glob source with epoch/commit/replay semantics."""
+
+    def __init__(self, pattern: str, mode: str = "binary"):
+        if mode not in ("binary", "csv"):
+            raise ValueError("mode must be binary|csv")
+        self.pattern = pattern
+        self.mode = mode
+        self._epoch = 0
+        self._offsets: dict = {}      # csv: path -> committed byte offset
+        self._seen: set = set()       # binary: committed file set
+        self._names: Optional[list] = None   # csv schema (first header)
+        self._pending = None          # (epoch, table, next_state) uncommitted
+        self._lock = threading.Lock()
+
+    # -- discovery -----------------------------------------------------------
+    def _discover_binary(self):
+        paths = [p for p in sorted(_glob.glob(self.pattern, recursive=True))
+                 if p not in self._seen]
+        if not paths:
+            return None, None
+        blobs = np.empty(len(paths), dtype=object)
+        for i, p in enumerate(paths):
+            with open(p, "rb") as f:
+                blobs[i] = f.read()
+        table = Table({"path": np.asarray(paths, dtype=object),
+                       "bytes": blobs})
+        return table, {"seen": self._seen | set(paths)}
+
+    def _discover_csv(self):
+        rows, names = [], self._names
+        next_offsets = dict(self._offsets)
+        for p in sorted(_glob.glob(self.pattern, recursive=True)):
+            start = self._offsets.get(p, 0)
+            with open(p, "rb") as f:
+                f.seek(start)
+                chunk = f.read()
+            # consume only complete lines; a torn tail stays for next poll
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                continue
+            complete, consumed = chunk[:cut + 1], start + cut + 1
+            lines = [l for l in complete.split(b"\n") if l.strip()]
+            if start == 0 and lines:
+                header = [h.strip().decode() for h in lines[0].split(b",")]
+                if names is None:
+                    names = header
+                elif header != names:
+                    raise ValueError(
+                        f"{p} header {header} does not match the stream "
+                        f"schema {names}")
+                lines = lines[1:]
+            rows.extend(lines)
+            next_offsets[p] = consumed
+        if not rows or names is None:
+            return None, None
+        # explicit per-line parse: a ragged or malformed row becomes NaN
+        # cells instead of wedging the stream (genfromtxt silently DROPS
+        # bad rows, which then breaks the row-count contract)
+        mat = np.full((len(rows), len(names)), np.nan, np.float32)
+        for i, ln in enumerate(rows):
+            parts = ln.split(b",")
+            for j in range(min(len(parts), len(names))):
+                try:
+                    mat[i, j] = float(parts[j])
+                except ValueError:
+                    pass
+        table = Table({nm: mat[:, j] for j, nm in enumerate(names)})
+        return table, {"offsets": next_offsets, "names": names}
+
+    # -- source API (ServingServer contract) ---------------------------------
+    def get_batch(self):
+        """(epoch, Table|None). Uncommitted epochs replay the cached batch."""
+        with self._lock:
+            if self._pending is not None:
+                return self._pending[0], self._pending[1]
+            table, nxt = (self._discover_binary() if self.mode == "binary"
+                          else self._discover_csv())
+            if table is None:
+                return self._epoch, None
+            self._pending = (self._epoch, table, nxt)
+            return self._epoch, table
+
+    def commit(self, epoch: int) -> None:
+        """Advance the durable position; only then does new data flow."""
+        with self._lock:
+            if self._pending is None or self._pending[0] != epoch:
+                return
+            nxt = self._pending[2]
+            if self.mode == "binary":
+                self._seen = nxt["seen"]
+            else:
+                self._offsets = nxt["offsets"]
+                self._names = nxt["names"]
+            self._pending = None
+            self._epoch = epoch + 1
+
+
+class FileStreamQuery:
+    """Pull loop: batch -> transform -> sink -> commit, with bounded replay
+    on failure (the ServingQuery recovery shape on a file source)."""
+
+    MAX_REPLAYS = 3
+
+    def __init__(self, source: FileStreamSource, transform_fn: Callable,
+                 sink: Callable, poll_interval: float = 0.05):
+        self.source = source
+        self.transform_fn = transform_fn
+        self.sink = sink
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._errors: list = []
+        self._recoveries = 0
+
+    def start(self) -> "FileStreamQuery":
+        self._thread.start()
+        return self
+
+    def _work(self):
+        replays = 0
+        while not self._stop.is_set():
+            try:
+                # discovery errors (schema drift, unreadable file) must not
+                # kill the worker thread silently — record and keep polling
+                epoch, table = self.source.get_batch()
+            except Exception as e:  # noqa: BLE001
+                if len(self._errors) < 1000:
+                    self._errors.append(e)
+                self._recoveries += 1
+                time.sleep(self.poll_interval * 4)
+                continue
+            if table is None:
+                time.sleep(self.poll_interval)
+                continue
+            try:
+                self.sink(self.transform_fn(table))
+                self.source.commit(epoch)
+                replays = 0
+            except Exception as e:  # noqa: BLE001 - worker survives, replays
+                if len(self._errors) < 1000:
+                    self._errors.append(e)
+                self._recoveries += 1
+                replays += 1
+                if replays > self.MAX_REPLAYS:
+                    # poison batch: skip it rather than wedging the stream
+                    self.source.commit(epoch)
+                    replays = 0
+                else:
+                    time.sleep(self.poll_interval * replays)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -149,14 +149,20 @@ class PipelinedLMTrainer:
 
             def tick(carry, t):
                 act, acc = carry
-                x0 = embed_mb(mbs[jnp.clip(t, 0, M - 1)])
-                x_in = jnp.where(s_idx == 0, x0, act)
+                # lax.cond, not where: where would run the embedding lookup
+                # on every stage and the full vocab-width LM head on every
+                # tick — cond pays each only where its result is consumed
+                x_in = jax.lax.cond(
+                    s_idx == 0,
+                    lambda: embed_mb(mbs[jnp.clip(t, 0, M - 1)]),
+                    lambda: act)
                 y = apply_stage(x_in)
                 out_idx = t - (S_P - 1)
                 valid = ((out_idx >= 0) & (out_idx < M)
                          & (s_idx == S_P - 1))
                 tok_out = mbs[jnp.clip(out_idx, 0, M - 1)]
-                acc = acc + jnp.where(valid, mb_loss(y, tok_out), 0.0)
+                acc = acc + jax.lax.cond(
+                    valid, lambda: mb_loss(y, tok_out), lambda: 0.0)
                 act = jax.lax.ppermute(
                     y, PIPE_AXIS,
                     [(i, (i + 1) % S_P) for i in range(S_P)])

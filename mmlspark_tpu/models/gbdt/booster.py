@@ -19,6 +19,10 @@ import numpy as np
 
 from . import trainer
 
+# beyond this depth the vmapped device path's (2^d, d, chunk) working set
+# and unrolled masked loops stop paying off; the host DFS takes over
+_DEVICE_SHAP_MAX_DEPTH = 8
+
 
 class Booster(NamedTuple):
     split_feature: np.ndarray   # (T, max_nodes) i32, -1 = leaf
@@ -78,7 +82,7 @@ class Booster(NamedTuple):
             self.split_feature[s], self.threshold[s], self.max_depth,
             split_is_cat=ic, cat_words=cw))
 
-    def feature_contributions(self, x):
+    def feature_contributions(self, x, backend: str = "auto"):
         """Per-feature additive contributions via exact path-dependent
         TreeSHAP (Lundberg et al. 2018, Algorithm 2) — the same attribution
         LightGBM's predict(pred_contrib=True) / the reference's featuresShap
@@ -89,7 +93,15 @@ class Booster(NamedTuple):
         are summed per feature (use tree_class to split if needed).
         Requires node covers (recorded during training); boosters loaded from
         pre-cover artifacts fall back to the Saabas approximation.
+
+        backend: "auto" uses the jitted device implementation
+        (shap_device.py — vmapped leaf paths, no host recursion) whenever
+        the tree depth allows it, falling back to the host DFS; "host"
+        forces the numpy oracle; "device" requires the device path.
         """
+        if backend not in ("auto", "device", "host"):
+            raise ValueError(
+                f"backend must be auto|device|host, got {backend!r}")
         x = np.asarray(x, dtype=np.float32)
         n = x.shape[0]
         contrib = np.zeros((n, self.n_features + 1), dtype=np.float64)
@@ -97,8 +109,25 @@ class Booster(NamedTuple):
         sf, thr, lv = self.split_feature[s], self.threshold[s], self.leaf_value[s]
         ic, cw = self._cat_args(s)
         if self.cover is None:
+            if backend == "device":
+                # an explicit exact-path request must not silently degrade
+                # to the Saabas approximation
+                raise ValueError(
+                    "device TreeSHAP needs node covers; this booster "
+                    "predates cover recording (Saabas fallback only)")
             return self._saabas_contributions(x, sf, thr, lv, ic, cw)
         cover = self.cover[s]
+        device_ok = self.max_depth <= _DEVICE_SHAP_MAX_DEPTH
+        if backend == "device" and not device_ok:
+            raise ValueError(
+                f"device TreeSHAP supports max_depth <= "
+                f"{_DEVICE_SHAP_MAX_DEPTH}; this booster has "
+                f"{self.max_depth}")
+        if backend in ("auto", "device") and device_ok and sf.shape[0]:
+            from .shap_device import shap_contributions_device
+            return shap_contributions_device(
+                x, sf, thr, lv, cover, self.n_features, self.max_depth,
+                split_is_cat=ic, cat_words=cw)
         for t in range(sf.shape[0]):
             phi = _tree_shap(sf[t], thr[t], lv[t], cover[t], x,
                              self.n_features,

@@ -162,7 +162,9 @@ class VowpalWabbitContextualBandit(Estimator, _VWParamsMixin):
     cost_col = Param("cost_col", "observed cost of the chosen action", "cost")
     probability_col = Param("probability_col", "logging propensity", "probability")
 
-    def _fit(self, t: Table) -> "VowpalWabbitContextualBanditModel":
+    def _cb_arrays(self, t: Table):
+        """Shared featurization for fit/parallel_fit: computed ONCE per
+        table no matter how many policies sweep over it."""
         idx, val = self._features(t)
         action = np.asarray(t[self.chosen_action_col]).astype(int) - 1
         cost = np.asarray(t[self.cost_col], np.float32)
@@ -173,19 +175,54 @@ class VowpalWabbitContextualBandit(Estimator, _VWParamsMixin):
         mask = (1 << self.num_bits) - 1
         a_idx = ((idx.astype(np.int64) * 31 + (action[:, None] + 1) * 0x9E3779B9)
                  & mask).astype(np.int32)
+        return a_idx, val, cost, prob
+
+    def _fit_arrays(self, est, a_idx, val, cost, prob):
         weights, bias, stats = fit_vw(
-            a_idx, val, cost, self._vw_params("squared"),
-            weights=1.0 / prob, num_tasks=self.num_tasks)
+            a_idx, val, cost, est._vw_params("squared"),
+            weights=1.0 / prob, num_tasks=est.num_tasks)
         # IPS / SNIPS diagnostics (TrainingStats ipsEstimate/snipsEstimate)
         ips_terms = cost / prob
         stats["ips_estimate"] = float(np.mean(ips_terms))
         stats["snips_estimate"] = float(ips_terms.sum() / max((1 / prob).sum(), 1e-9))
         m = VowpalWabbitContextualBanditModel(
             weights=weights, bias=bias, stats=stats,
-            features_col=self.features_col, prediction_col=self.prediction_col,
-            num_bits=self.num_bits)
-        m.set(num_actions=self.num_actions)
+            features_col=est.features_col, prediction_col=est.prediction_col,
+            num_bits=est.num_bits)
+        m.set(num_actions=est.num_actions)
         return m
+
+    def _fit(self, t: Table) -> "VowpalWabbitContextualBanditModel":
+        return self._fit_arrays(self, *self._cb_arrays(t))
+
+    def parallel_fit(self, t: Table, param_maps):
+        """Synchronous multi-policy sweep (reference: parallelFit,
+        vw/VowpalWabbitContextualBandit.scala — fits one CB model per
+        ParamMap in a thread pool for policy evaluation).
+
+        param_maps: list of {param_name: value} overrides (e.g. sweeping
+        learning_rate / l2 / interactions). Featurization is computed once
+        and shared; returns models in param_maps order, each carrying its
+        own ips_estimate / snips_estimate in get_performance_statistics().
+        """
+        from concurrent.futures import ThreadPoolExecutor
+        arrays = self._cb_arrays(t)
+        # everything baked into the shared arrays must not vary inside a
+        # sweep: feature hashing AND the logged-data columns — an override
+        # of these would be silently ignored (arrays are computed once)
+        frozen = ("num_bits", "features_col", "chosen_action_col",
+                  "cost_col", "probability_col")
+        for pm in param_maps:
+            bad = [k for k in pm if k in frozen]
+            if bad:
+                raise ValueError(
+                    f"parallel_fit shares one featurization; {bad} cannot "
+                    "vary per policy — run separate fits instead")
+        ests = [self.copy(pm) for pm in param_maps]
+        with ThreadPoolExecutor(max_workers=min(len(ests), 8) or 1) as pool:
+            futs = [pool.submit(self._fit_arrays, est, *arrays)
+                    for est in ests]
+            return [f.result() for f in futs]
 
 
 class VowpalWabbitContextualBanditModel(_VWModelBase):

@@ -221,6 +221,66 @@ def test_contextual_bandit():
     fuzz_estimator(cb, t)
 
 
+def test_contextual_bandit_parallel_fit():
+    """Multi-policy sweep (reference: parallelFit,
+    vw/VowpalWabbitContextualBandit.scala): one shared featurization, a
+    thread-pool of fits, per-policy IPS/SNIPS on each returned model."""
+    rng = np.random.default_rng(5)
+    n, d, A = 1500, 4, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_actions = rng.normal(size=(A, d))
+    true_cost = x @ w_actions.T
+    chosen = rng.integers(0, A, size=n)
+    t = Table({"features": x,
+               "chosen_action": (chosen + 1).astype(np.float64),
+               "cost": true_cost[np.arange(n), chosen].astype(np.float32),
+               "probability": np.full(n, 1.0 / A, np.float32)})
+    cb = VowpalWabbitContextualBandit(num_actions=A, num_passes=8,
+                                      num_tasks=1)
+    maps = [{"learning_rate": 0.5}, {"learning_rate": 0.05},
+            {"l2": 1e-3, "num_passes": 4}]
+    models = cb.parallel_fit(t, maps)
+    assert len(models) == 3
+    for m in models:
+        assert "ips_estimate" in m._stats and "snips_estimate" in m._stats
+        picked = np.asarray(m.transform(t)["prediction"]).astype(int) - 1
+        assert picked.min() >= 0 and picked.max() < A
+    # sweep order preserved and models genuinely differ
+    w0, w1 = models[0]._weights, models[1]._weights
+    assert not np.allclose(w0, w1)
+    # per-map fit equals the sequential fit with the same overrides
+    seq = cb.copy(maps[1]).fit(t)
+    np.testing.assert_allclose(models[1]._weights, seq._weights)
+    # feature-space params are frozen across a sweep
+    with pytest.raises(ValueError, match="featurization"):
+        cb.parallel_fit(t, [{"num_bits": 12}])
+
+
+def test_featurizer_matches_native_murmur_on_unicode():
+    """Property test (round-2 verdict item 9): the Python murmur3 the
+    featurizer uses and the C++ batch kernel must agree bit-for-bit on
+    arbitrary unicode — namespace seeds and feature indices both."""
+    from mmlspark_tpu.native import hash_strings_native
+    from mmlspark_tpu.ops.hashing import murmur3_32
+    native = hash_strings_native(["probe"], seed=0)
+    if native is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(11)
+    pool = ("word", "héllo", "Ωμέγα", "日本語テキスト", "🙂🚀", "a,b|c:d",
+            "", " ", "\t", "ascii_only", "ñandú", "\x00zero",
+            "long" * 50, "Ψαλμός", "123.456", "émoji🎛mix")
+    values = [str(rng.choice(pool)) + str(rng.integers(0, 10))
+              for _ in range(300)]
+    for seed in (0, 42, 0x9E3779B9 & 0x7FFFFFFF):
+        got = hash_strings_native(values, seed=seed)
+        want = np.asarray([murmur3_32(v.encode("utf-8"), seed)
+                           for v in values], np.int64)
+        np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+        # masked variant (the featurizer's actual indexing path)
+        got_m = hash_strings_native(values, seed=seed, num_bits=18)
+        np.testing.assert_array_equal(got_m, want & ((1 << 18) - 1))
+
+
 def test_high_cardinality_sparse_features_learnable():
     """Rare hashed features (few examples each) must be learnable with the
     default mode — VW's real default is --adaptive, and plain minibatch SGD's
