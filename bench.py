@@ -123,6 +123,64 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
     return out, booster, x
 
 
+def _bench_flash():
+    """16k-token causal flash attention (README flash row's source):
+    f32 and bf16 operand timings via chained in-graph repetition."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.flash_attention import flash_attention
+    rng = np.random.default_rng(0)
+    s, h, d = 16384, 8, 64
+    out = {}
+    for name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        q = jnp.asarray(rng.normal(size=(s, h, d)), dt)
+        k = jnp.asarray(rng.normal(size=(s, h, d)), dt)
+        v = jnp.asarray(rng.normal(size=(s, h, d)), dt)
+
+        @jax.jit
+        def reps(q, k, v):
+            def body(c, i):
+                o = flash_attention(q * (1 + i * 1e-6), k, v, causal=True)
+                return c + o.astype(jnp.float32).sum(), None
+            s_, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(25))
+            return s_
+        float(reps(q, k, v))            # compile + warm
+        t0 = time.time()
+        float(reps(q, k, v))
+        # 25 in-graph reps amortize the tunnel's ~100 ms dispatch+fetch
+        out[name + "_ms"] = round((time.time() - t0) / 25 * 1000, 1)
+    print(json.dumps({"metric": "flash_attention_16k_causal",
+                      "value": out["bf16_ms"], "unit": "ms",
+                      "vs_baseline": 0.0, **out}))
+
+
+def _bench_resnet():
+    """ResNet-50 bf16 inference imgs/sec (README resnet row's source)."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.dnn.resnet import init_resnet, resnet50
+    model = resnet50(dtype=jnp.bfloat16)
+    params = init_resnet(model, seed=0)
+    batch = 128
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(batch, 224, 224, 3)), jnp.bfloat16)
+
+    @jax.jit
+    def reps(x):
+        def body(c, i):
+            y = model.apply(params, x * (1 + i * 1e-6))
+            return c + y.astype(jnp.float32).sum(), None
+        s_, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(10))
+        return s_
+    float(reps(x))
+    t0 = time.time()
+    float(reps(x))
+    dt = (time.time() - t0) / 10
+    print(json.dumps({"metric": "resnet50_bf16_imgs_per_sec",
+                      "value": round(batch / dt, 1), "unit": "imgs/s",
+                      "vs_baseline": 0.0}))
+
+
 def main():
     import jax
     # persistent compilation cache: later rounds skip the multi-minute
@@ -134,9 +192,14 @@ def main():
     except Exception:
         pass
 
-    # predict mode never prints the bandwidth fields — don't spend the
+    mode = os.environ.get("BENCH_MODE", "")
+    if mode == "flash":
+        return _bench_flash()
+    if mode == "resnet":
+        return _bench_resnet()
+    # predict/shap modes never print the bandwidth fields — don't spend the
     # ~40 timed 1 GiB copy passes measuring one
-    copy_gbps = (0.0 if os.environ.get("BENCH_MODE") == "predict"
+    copy_gbps = (0.0 if mode in ("predict", "shap")
                  else measure_copy_bandwidth_gbps())
     if os.environ.get("BENCH_SHAPES") == "wide":
         # verdict round-2 item 1: more shapes so the headline isn't a

@@ -27,6 +27,7 @@ with bounded replay on failure (same recovery shape as ServingQuery).
 from __future__ import annotations
 
 import glob as _glob
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -47,14 +48,32 @@ class FileStreamSource:
         self._epoch = 0
         self._offsets: dict = {}      # csv: path -> committed byte offset
         self._seen: set = set()       # binary: committed file set
+        self._sizes: dict = {}        # binary: path -> size at last poll
         self._names: Optional[list] = None   # csv schema (first header)
         self._pending = None          # (epoch, table, next_state) uncommitted
         self._lock = threading.Lock()
+        # csv files that failed discovery (schema drift, unreadable):
+        # path -> error. Quarantined so ONE bad file can't halt the stream.
+        self.quarantined: dict = {}
 
     # -- discovery -----------------------------------------------------------
     def _discover_binary(self):
-        paths = [p for p in sorted(_glob.glob(self.pattern, recursive=True))
-                 if p not in self._seen]
+        """New files whose size is STABLE across two polls — a producer
+        mid-write is deferred to the next poll rather than captured
+        truncated and lost forever (atomic rename into the directory is
+        still the airtight pattern; this guard covers plain writers)."""
+        paths = []
+        for p in sorted(_glob.glob(self.pattern, recursive=True)):
+            if p in self._seen:
+                continue
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                continue
+            if self._sizes.get(p) == size:
+                paths.append(p)
+            else:
+                self._sizes[p] = size   # first sighting / still growing
         if not paths:
             return None, None
         blobs = np.empty(len(paths), dtype=object)
@@ -69,10 +88,17 @@ class FileStreamSource:
         rows, names = [], self._names
         next_offsets = dict(self._offsets)
         for p in sorted(_glob.glob(self.pattern, recursive=True)):
+            if p in self.quarantined:
+                continue
             start = self._offsets.get(p, 0)
-            with open(p, "rb") as f:
-                f.seek(start)
-                chunk = f.read()
+            try:
+                with open(p, "rb") as f:
+                    f.seek(start)
+                    chunk = f.read()
+            except OSError as e:
+                # one unreadable file must not halt the whole stream
+                self.quarantined[p] = e
+                continue
             # consume only complete lines; a torn tail stays for next poll
             cut = chunk.rfind(b"\n")
             if cut < 0:
@@ -84,9 +110,12 @@ class FileStreamSource:
                 if names is None:
                     names = header
                 elif header != names:
-                    raise ValueError(
+                    # quarantine the drifted file, keep the stream flowing
+                    # from the conforming ones (inspect source.quarantined)
+                    self.quarantined[p] = ValueError(
                         f"{p} header {header} does not match the stream "
                         f"schema {names}")
+                    continue
                 lines = lines[1:]
             rows.extend(lines)
             next_offsets[p] = consumed
@@ -135,10 +164,18 @@ class FileStreamSource:
 
 
 class FileStreamQuery:
-    """Pull loop: batch -> transform -> sink -> commit, with bounded replay
-    on failure (the ServingQuery recovery shape on a file source)."""
+    """Pull loop: batch -> transform -> sink -> commit, with replay on
+    failure (the ServingQuery recovery shape on a file source).
 
-    MAX_REPLAYS = 3
+    By DEFAULT failed batches replay forever with capped backoff — unlike
+    serving (where a bounded replay ends in a visible 502 to the waiting
+    client), a file source has no requester to signal, so dropping a batch
+    after a few fast retries would silently lose data during a transient
+    sink outage. Set MAX_REPLAYS to an int to opt into poison-skipping
+    (the skipped batch's error stays in `_errors`)."""
+
+    MAX_REPLAYS: Optional[int] = None   # None = at-least-once, never drop
+    MAX_BACKOFF = 1.0
 
     def __init__(self, source: FileStreamSource, transform_fn: Callable,
                  sink: Callable, poll_interval: float = 0.05):
@@ -180,12 +217,13 @@ class FileStreamQuery:
                     self._errors.append(e)
                 self._recoveries += 1
                 replays += 1
-                if replays > self.MAX_REPLAYS:
-                    # poison batch: skip it rather than wedging the stream
+                if self.MAX_REPLAYS is not None and replays > self.MAX_REPLAYS:
+                    # opted-in poison skip: drop the batch, keep streaming
                     self.source.commit(epoch)
                     replays = 0
                 else:
-                    time.sleep(self.poll_interval * replays)
+                    self._stop.wait(min(self.poll_interval * replays,
+                                        self.MAX_BACKOFF))
 
     def stop(self):
         self._stop.set()

@@ -201,7 +201,7 @@ class VowpalWabbitContextualBandit(Estimator, _VWParamsMixin):
         ParamMap in a thread pool for policy evaluation).
 
         param_maps: list of {param_name: value} overrides (e.g. sweeping
-        learning_rate / l2 / interactions). Featurization is computed once
+        learning_rate / l2 / num_passes). Featurization is computed once
         and shared; returns models in param_maps order, each carrying its
         own ips_estimate / snips_estimate in get_performance_statistics().
         """

@@ -56,9 +56,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(visible)
     def _attend():
         # note: the f32 casts here are what Mosaic wants — it fuses them
-        # into the matmul and runs bf16 INPUTS at 15.9 ms vs 22.5 ms f32 at
-        # 16k causal on v5e; keeping operands in input dtype with post-scale
-        # measured SLOWER (20.7 ms). Accumulation stays f32 either way.
+        # into the matmul; bf16 and f32 operands measure within tunnel noise
+        # of each other (~24-27 ms at 16k causal on v5e, BENCH_MODE=flash);
+        # keeping operands in input dtype with post-scale measured SLOWER.
+        # Accumulation stays f32 either way.
         q = q_ref[0].astype(jnp.float32) * scale      # (Bq, D)
         k = k_ref[0].astype(jnp.float32)              # (Bk, D)
         v = v_ref[0].astype(jnp.float32)              # (Bk, D)
@@ -78,12 +79,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_prev = l_ref[...]
         m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
-        # mask p explicitly: when a row has seen NO valid key yet, m_new is
-        # still -1e30 and exp(s - m_new) would be 1 for masked entries,
-        # polluting acc/l for callers that normalize stats directly (the
-        # in-repo ring consumer is safe via the m==-1e30 merge weight, but
-        # flash_attention_stats is a public entry point)
-        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # (Bq, Bk)
+        # NOTE: p is deliberately NOT masked with `valid` here — an extra
+        # where on the (Bq, Bk) tile adds measurable inner-loop VPU work at
+        # zero benefit for supported callers. The only rows affected are
+        # ones that have seen NO valid key yet (m_new still -1e30, masked
+        # entries contribute exp(0)=1): impossible on the normalize path
+        # (causal row i always sees key 0; padding only trims the tail),
+        # and on the stats path such rows are FLAGGED by m == -1e30 — the
+        # ring consumer's merge weight exp(m - m_new) zeroes them. Direct
+        # flash_attention_stats callers must treat m == -1e30 rows as
+        # "no visible keys" rather than normalizing acc/l.
+        p = jnp.exp(s - m_new)                        # (Bq, Bk)
         alpha = jnp.exp(m_prev - m_new)               # rescale old carry
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = (acc_ref[...] * alpha
@@ -173,7 +179,15 @@ def flash_attention_stats(q, k, v, q_offset, k_offset, causal: bool,
     values welcome — they enter the kernel through SMEM). Differentiable:
     the custom VJP recomputes the same contract densely in XLA on the
     backward, like flash_attention. This is what lets ring attention run
-    flash WITHIN each device while `ppermute` rotates K/V ACROSS devices."""
+    flash WITHIN each device while `ppermute` rotates K/V ACROSS devices.
+
+    CONTRACT (tested in test_flash_attention.py::test_stats_no_visible_key
+    _contract): a q row with NO visible key in this block (causal offsets)
+    returns garbage acc/l FLAGGED by m == -1e30 — consumers must fold such
+    rows with zero weight (the ring merge's exp(m - m_new) does exactly
+    that) instead of normalizing acc/l directly. Masking them inside the
+    kernel would add inner-loop VPU work on every tile to benefit only
+    this degenerate case (see the p computation note)."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     return _flash_stats_vjp(q, k, v,

@@ -64,3 +64,36 @@ def test_fully_masked_rows_are_zero():
                               jnp.asarray(v[:40]))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_stats_no_visible_key_contract():
+    """flash_attention_stats' documented contract: a q row with NO visible
+    key in the block (causal, q before k) is FLAGGED by m == -1e30 and its
+    acc/l must be folded with zero weight, never normalized directly. This
+    pins the contract so the kernel's unmasked-p fast path stays safe."""
+    import numpy as np
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.flash_attention import flash_attention_stats
+
+    rng = np.random.default_rng(0)
+    h, s, d = 2, 128, 64
+    q = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+    # causal with the whole k block AFTER the whole q block: no q row sees
+    # any key
+    acc, m, l = flash_attention_stats(q, k, v, q_offset=0, k_offset=s,
+                                      causal=True, scale=1.0)
+    assert np.all(np.asarray(m) <= -1e29), "empty rows must stay flagged"
+    # the ring-merge fold: weight exp(m - m_new) with any finite m_new
+    # zeroes these rows' contribution exactly
+    w = np.exp(np.asarray(m) - 0.0)
+    assert np.all(w == 0.0)
+    # and a block where the LAST rows see keys but the first do not:
+    # flagged rows and real rows coexist, flags are per-row
+    acc2, m2, l2 = flash_attention_stats(q, k, v, q_offset=0,
+                                         k_offset=s // 2, causal=True,
+                                         scale=1.0)
+    m2 = np.asarray(m2)  # (h, s)
+    assert np.all(m2[:, : s // 2] <= -1e29)     # rows before the k block
+    assert np.all(np.isfinite(m2[:, s // 2:]) & (m2[:, s // 2:] > -1e29))

@@ -17,6 +17,10 @@ def test_binary_source_discovers_incrementally(tmp_path):
     assert batch is None
     (tmp_path / "a.bin").write_bytes(b"AAA")
     (tmp_path / "b.bin").write_bytes(b"BB")
+    # first sighting records sizes; the second poll (stable size) delivers —
+    # the guard that keeps mid-write files from being captured truncated
+    _, settling = src.get_batch()
+    assert settling is None
     epoch, batch = src.get_batch()
     assert len(batch) == 2 and sorted(
         os.path.basename(p) for p in batch["path"]) == ["a.bin", "b.bin"]
@@ -25,6 +29,8 @@ def test_binary_source_discovers_incrementally(tmp_path):
     epoch2, again = src.get_batch()
     assert epoch2 == epoch and len(again) == 2
     src.commit(epoch)
+    _, settling = src.get_batch()      # c.bin sighted, size recorded
+    assert settling is None
     epoch3, nxt = src.get_batch()
     assert epoch3 == epoch + 1
     assert [os.path.basename(p) for p in nxt["path"]] == ["c.bin"]
@@ -53,7 +59,9 @@ def test_csv_tail_consumes_only_complete_lines(tmp_path):
     src.commit(e2)
 
 
-def test_csv_multi_file_schema_enforced(tmp_path):
+def test_csv_schema_drift_quarantined_not_fatal(tmp_path):
+    """One drifted file must be QUARANTINED while conforming files keep
+    streaming — a dropped bad file must not halt ingestion."""
     (tmp_path / "a.csv").write_text("x,y\n1,2\n")
     (tmp_path / "b.csv").write_text("x,y\n3,4\n")
     src = FileStreamSource(str(tmp_path / "*.csv"), mode="csv")
@@ -61,8 +69,13 @@ def test_csv_multi_file_schema_enforced(tmp_path):
     np.testing.assert_array_equal(np.sort(np.asarray(b["x"])), [1, 3])
     src.commit(e)
     (tmp_path / "c.csv").write_text("p,q\n9,9\n")
-    with pytest.raises(ValueError, match="schema"):
-        src.get_batch()
+    with open(tmp_path / "a.csv", "a") as fh:
+        fh.write("5,6\n")
+    e2, b2 = src.get_batch()           # good data still flows
+    np.testing.assert_array_equal(b2["x"], [5])
+    src.commit(e2)
+    assert str(tmp_path / "c.csv") in src.quarantined
+    assert "schema" in str(src.quarantined[str(tmp_path / "c.csv")])
 
 
 def test_stream_through_pipeline_with_replay(tmp_path):
@@ -126,8 +139,8 @@ def test_ragged_rows_become_nan_not_wedge(tmp_path):
 
 
 def test_discovery_error_survives_worker(tmp_path):
-    """Schema drift mid-stream must record an error and keep polling, not
-    silently kill the worker thread."""
+    """Schema drift mid-stream: the bad file is quarantined, the worker
+    stays alive, and GOOD data keeps flowing afterwards."""
     (tmp_path / "a.csv").write_text("x,y\n1,2\n")
     src = FileStreamSource(str(tmp_path / "*.csv"), mode="csv")
     got = []
@@ -138,14 +151,22 @@ def test_discovery_error_survives_worker(tmp_path):
         while not got and time.time() < deadline:
             time.sleep(0.02)
         (tmp_path / "b.csv").write_text("p,q\n9,9\n")  # wrong schema
-        while not q._errors and time.time() < deadline:
+        while not src.quarantined and time.time() < deadline:
             time.sleep(0.02)
-        assert q._errors and q._thread.is_alive()
+        assert src.quarantined and q._thread.is_alive()
+        with open(tmp_path / "a.csv", "a") as fh:
+            fh.write("7,8\n")
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == [1.0, 7.0]       # the stream never stopped
     finally:
         q.stop()
 
 
 def test_poison_batch_skipped_after_bounded_replay(tmp_path):
+    """Poison-skip is OPT-IN (default replays forever: a file source has
+    no client to 502, so dropping data on transient sink outages would be
+    silent loss)."""
     (tmp_path / "p.bin").write_bytes(b"poison")
     src = FileStreamSource(str(tmp_path / "*.bin"), mode="binary")
     q = FileStreamQuery(src, lambda t: 1 / 0, lambda out: None,
@@ -161,6 +182,7 @@ def test_poison_batch_skipped_after_bounded_replay(tmp_path):
         q.stop()
     # the poison epoch was committed away; a fresh poll sees only new files
     (tmp_path / "ok.bin").write_bytes(b"fine")
+    src.get_batch()                    # size-stability sighting poll
     e, b = src.get_batch()
     assert b is not None and [os.path.basename(p) for p in b["path"]] \
         == ["ok.bin"]
