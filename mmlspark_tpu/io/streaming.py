@@ -40,6 +40,8 @@ from ..core import Table
 class FileStreamSource:
     """Incremental glob source with epoch/commit/replay semantics."""
 
+    _READ_RETRIES = 5   # consecutive OSErrors on one file before quarantine
+
     def __init__(self, pattern: str, mode: str = "binary"):
         if mode not in ("binary", "csv"):
             raise ValueError("mode must be binary|csv")
@@ -52,9 +54,13 @@ class FileStreamSource:
         self._names: Optional[list] = None   # csv schema (first header)
         self._pending = None          # (epoch, table, next_state) uncommitted
         self._lock = threading.Lock()
-        # csv files that failed discovery (schema drift, unreadable):
-        # path -> error. Quarantined so ONE bad file can't halt the stream.
+        # files whose discovery failed DETERMINISTICALLY (schema drift, or
+        # read errors persisting past _READ_RETRIES polls): path -> error.
+        # Quarantined so one bad file can't halt the stream; transient
+        # OSErrors retry first (a brief EIO/EMFILE blip must not silently
+        # drop a file's future data forever).
         self.quarantined: dict = {}
+        self._read_failures: dict = {}   # path -> consecutive OSError count
 
     # -- discovery -----------------------------------------------------------
     def _discover_binary(self):
@@ -63,12 +69,21 @@ class FileStreamSource:
         truncated and lost forever (atomic rename into the directory is
         still the airtight pattern; this guard covers plain writers)."""
         paths = []
-        for p in sorted(_glob.glob(self.pattern, recursive=True)):
+        current = sorted(_glob.glob(self.pattern, recursive=True))
+        # prune stale sightings: a deleted-then-recreated file must restart
+        # its stability window (a stale size equal to a new partial write
+        # would defeat the truncation guard), and _sizes must not grow
+        # unboundedly in a long-running stream
+        live = set(current)
+        self._sizes = {p: sz for p, sz in self._sizes.items()
+                       if p in live and p not in self._seen}
+        for p in current:
             if p in self._seen:
                 continue
             try:
                 size = os.path.getsize(p)
             except OSError:
+                self._sizes.pop(p, None)
                 continue
             if self._sizes.get(p) == size:
                 paths.append(p)
@@ -96,9 +111,14 @@ class FileStreamSource:
                     f.seek(start)
                     chunk = f.read()
             except OSError as e:
-                # one unreadable file must not halt the whole stream
-                self.quarantined[p] = e
+                # transient read errors retry; only persistent ones
+                # quarantine (deterministic drift is quarantined below)
+                n_fail = self._read_failures.get(p, 0) + 1
+                self._read_failures[p] = n_fail
+                if n_fail > self._READ_RETRIES:
+                    self.quarantined[p] = e
                 continue
+            self._read_failures.pop(p, None)
             # consume only complete lines; a torn tail stays for next poll
             cut = chunk.rfind(b"\n")
             if cut < 0:

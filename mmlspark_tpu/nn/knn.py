@@ -174,7 +174,7 @@ class ConditionalKNNModel(Model, _KNNParams, HasLabelCol):
         per_row = [np.atleast_1d(c) for c in conditioners]
         lens = np.asarray([p.size for p in per_row])
         allowed = np.zeros((len(t), len(uniq)), dtype=bool)
-        if lens.sum():
+        if lens.sum() and len(uniq):   # empty index -> all-False mask
             flat = np.concatenate(per_row)
             rows = np.repeat(np.arange(len(t)), lens)
             pos = np.searchsorted(uniq, flat)
