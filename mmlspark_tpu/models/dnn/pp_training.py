@@ -33,11 +33,17 @@ def _stack_layers(layers: list) -> dict:
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *layers)
 
 
-def _block(x, lp, h: int, dh: int):
+def _block(x, lp, h: int, dh: int, attention: str = "dense"):
     """One transformer block on a (S, d) sequence — the same math as
-    transformer_apply's loop body (dense causal attention), kept in lockstep
+    transformer_apply's loop body (causal attention), kept in lockstep
     so pipelined and unpipelined losses agree bit-for-bit up to reduction
-    order (parity-tested)."""
+    order (parity-tested).
+
+    attention="flash" routes through the Pallas kernel (with its flash
+    BACKWARD — O(block) training memory): legal here because shard_map
+    hands each pipeline stage per-device code, where a pallas_call is just
+    a local op. The GSPMD dp x tp trainer (lm_training.py) keeps dense
+    attention — pallas calls do not auto-partition under GSPMD."""
     import jax
     import jax.numpy as jnp
     from ...parallel.ring_attention import reference_attention
@@ -48,7 +54,11 @@ def _block(x, lp, h: int, dh: int):
     q = (y @ lp["wq"]).reshape(seq, h, dh)
     k = (y @ lp["wk"]).reshape(seq, h, dh)
     v = (y @ lp["wv"]).reshape(seq, h, dh)
-    a = reference_attention(q, k, v, causal=True)
+    if attention == "flash":
+        from ...ops.flash_attention import flash_attention
+        a = flash_attention(q, k, v, causal=True)
+    else:
+        a = reference_attention(q, k, v, causal=True)
     x = x + a.reshape(seq, d) @ lp["wo"]
     y = _layer_norm(x, lp["ln2"])
     return x + jax.nn.gelu(y @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
@@ -66,7 +76,9 @@ class PipelinedLMTrainer:
     def __init__(self, vocab_size: int, mesh=None, n_microbatches: int = 4,
                  d_model: int = 128, n_heads: int = 8, n_layers: int = 4,
                  d_ff: int = 256, max_len: int = 512, lr: float = 1e-3,
-                 seed: int = 0):
+                 seed: int = 0, attention: str = "dense"):
+        if attention not in ("dense", "flash"):
+            raise ValueError("attention must be dense|flash")
         import jax
         import jax.numpy as jnp
         import optax
@@ -130,7 +142,8 @@ class PipelinedLMTrainer:
 
             def apply_stage(x):      # (mb, S, d) through this stage's layers
                 def one_layer(h_x, lp):
-                    return jax.vmap(lambda xx: _block(xx, lp, h, dh))(h_x), None
+                    return jax.vmap(lambda xx: _block(
+                        xx, lp, h, dh, attention=attention))(h_x), None
                 x, _ = jax.lax.scan(one_layer, x, p["layers"])
                 return x
 
