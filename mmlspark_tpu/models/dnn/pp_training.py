@@ -33,7 +33,60 @@ def _stack_layers(layers: list) -> dict:
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *layers)
 
 
-def _block(x, lp, h: int, dh: int, attention: str = "dense"):
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _tp_f(axis: str):
+    """Megatron's `f` operator: identity forward, psum-over-tp backward.
+    Placed at each sublayer input so activation COTANGENTS — partial per
+    model shard after flowing back through that shard's weight slice — are
+    summed back to full. With f in place, every replicated parameter's
+    gradient comes out identical on all model shards and NO gradient
+    collective over the model axis is needed; sharded weights' gradients
+    are complete locally (the psum's own transpose broadcasts)."""
+    import jax
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@_functools.lru_cache(maxsize=None)
+def _tp_g(axis: str):
+    """Megatron's `g` operator: psum forward, IDENTITY backward. Under
+    shard_map with replication checking off, a bare psum's transpose is
+    another psum — the already-replicated output cotangent would be summed
+    again, overcounting every row-parallel weight's gradient tp times
+    (non-uniformly vs the column side, so even Adam diverges). Pairing
+    g (here) with f (above) pins both directions explicitly."""
+    import jax
+
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis)
+
+    def fwd(x):
+        return jax.lax.psum(x, axis), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+def _block(x, lp, h: int, dh: int, attention: str = "dense",
+           tp_axis=None):
     """One transformer block on a (S, d) sequence — the same math as
     transformer_apply's loop body (causal attention), kept in lockstep
     so pipelined and unpipelined losses agree bit-for-bit up to reduction
@@ -43,7 +96,12 @@ def _block(x, lp, h: int, dh: int, attention: str = "dense"):
     BACKWARD — O(block) training memory): legal here because shard_map
     hands each pipeline stage per-device code, where a pallas_call is just
     a local op. The GSPMD dp x tp trainer (lm_training.py) keeps dense
-    attention — pallas calls do not auto-partition under GSPMD."""
+    attention — pallas calls do not auto-partition under GSPMD.
+
+    tp_axis: Megatron tensor parallelism INSIDE the stage. lp's weight
+    leaves arrive column-sliced (wq/wk/wv/w1 on outputs, wo/w2 on inputs
+    — h must be the LOCAL head count), activations stay replicated, and
+    one psum over tp_axis closes each of the two row-parallel matmuls."""
     import jax
     import jax.numpy as jnp
     from ...parallel.ring_attention import reference_attention
@@ -51,6 +109,8 @@ def _block(x, lp, h: int, dh: int, attention: str = "dense"):
 
     seq, d = x.shape
     y = _layer_norm(x, lp["ln1"])
+    if tp_axis is not None:
+        y = _tp_f(tp_axis)(y)
     q = (y @ lp["wq"]).reshape(seq, h, dh)
     k = (y @ lp["wk"]).reshape(seq, h, dh)
     v = (y @ lp["wv"]).reshape(seq, h, dh)
@@ -59,9 +119,18 @@ def _block(x, lp, h: int, dh: int, attention: str = "dense"):
         a = flash_attention(q, k, v, causal=True)
     else:
         a = reference_attention(q, k, v, causal=True)
-    x = x + a.reshape(seq, d) @ lp["wo"]
+    att = a.reshape(seq, h * dh) @ lp["wo"]
+    if tp_axis is not None:
+        att = _tp_g(tp_axis)(att)
+    x = x + att
     y = _layer_norm(x, lp["ln2"])
-    return x + jax.nn.gelu(y @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+    if tp_axis is not None:
+        y = _tp_f(tp_axis)(y)
+    ff = jax.nn.gelu(y @ lp["w1"] + lp["b1"]) @ lp["w2"]
+    if tp_axis is not None:
+        ff = _tp_g(tp_axis)(ff)
+    # b2 is replicated across tp: add OUTSIDE the psum or it counts tp x
+    return x + ff + lp["b2"]
 
 
 class PipelinedLMTrainer:
@@ -83,7 +152,7 @@ class PipelinedLMTrainer:
         import jax.numpy as jnp
         import optax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from ...parallel import DATA_AXIS, PIPE_AXIS, grid_mesh
+        from ...parallel import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, grid_mesh
         from ...parallel.shard import shard_map
 
         if mesh is None:
@@ -96,8 +165,17 @@ class PipelinedLMTrainer:
             raise ValueError(
                 f"n_layers ({n_layers}) must divide by the pipe axis "
                 f"({n_stages}) so every stage holds the same layer count")
+        # optional third axis: Megatron tensor parallelism inside each stage
+        tp = mesh.shape[MODEL_AXIS] if MODEL_AXIS in mesh.axis_names else 1
+        if n_heads % tp:
+            raise ValueError(
+                f"n_heads ({n_heads}) must divide by the model axis ({tp})")
+        if d_ff % tp:
+            raise ValueError(
+                f"d_ff ({d_ff}) must divide by the model axis ({tp})")
         self.mesh = mesh
         self.n_stages = n_stages
+        self.tp = tp
         self.n_microbatches = n_microbatches
 
         raw = init_transformer(vocab_size, d_model, n_heads, n_layers,
@@ -109,8 +187,24 @@ class PipelinedLMTrainer:
             "final_ln": raw["final_ln"],
         }
 
-        layer_specs = jax.tree_util.tree_map(
-            lambda _: P(PIPE_AXIS), params["layers"])
+        if tp == 1:
+            layer_specs = jax.tree_util.tree_map(
+                lambda _: P(PIPE_AXIS), params["layers"])
+        else:
+            # stage dim over PIPE + Megatron layout over MODEL:
+            # qkv/w1 column-parallel (outputs), wo/w2 row-parallel (inputs)
+            ln = {"scale": P(PIPE_AXIS, None), "bias": P(PIPE_AXIS, None)}
+            layer_specs = {
+                "ln1": dict(ln), "ln2": dict(ln),
+                "wq": P(PIPE_AXIS, None, MODEL_AXIS),
+                "wk": P(PIPE_AXIS, None, MODEL_AXIS),
+                "wv": P(PIPE_AXIS, None, MODEL_AXIS),
+                "wo": P(PIPE_AXIS, MODEL_AXIS, None),
+                "w1": P(PIPE_AXIS, None, MODEL_AXIS),
+                "b1": P(PIPE_AXIS, MODEL_AXIS),
+                "w2": P(PIPE_AXIS, MODEL_AXIS, None),
+                "b2": P(PIPE_AXIS, None),
+            }
         self._param_specs = {
             "layers": layer_specs,
             "embed": P(), "pos": P(), "final_ln":
@@ -125,11 +219,12 @@ class PipelinedLMTrainer:
         self.opt_state = self._opt.init(self.params)
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
 
-        h = self.meta["n_heads"]
+        h_loc = self.meta["n_heads"] // tp   # local heads per model shard
         d = self.meta["d_model"]
-        dh = d // h
+        dh = d // self.meta["n_heads"]
         M = n_microbatches
         S_P = n_stages
+        tp_axis = MODEL_AXIS if tp > 1 else None
         opt = self._opt
 
         def device_loss(p, tokens):
@@ -143,7 +238,8 @@ class PipelinedLMTrainer:
             def apply_stage(x):      # (mb, S, d) through this stage's layers
                 def one_layer(h_x, lp):
                     return jax.vmap(lambda xx: _block(
-                        xx, lp, h, dh, attention=attention))(h_x), None
+                        xx, lp, h_loc, dh, attention=attention,
+                        tp_axis=tp_axis))(h_x), None
                 x, _ = jax.lax.scan(one_layer, x, p["layers"])
                 return x
 

@@ -95,3 +95,42 @@ def test_flash_attention_pipeline_parity():
     with pytest.raises(ValueError, match="dense|flash"):
         PipelinedLMTrainer(mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
                            attention="ring", **kw)
+
+
+def test_3d_dp_pp_tp_parity():
+    """The full 3D composition — GPipe stages x Megatron tensor slices x
+    data parallel in ONE shard_map — must reproduce the dp-only oracle's
+    Adam trajectory. This pins the f/g operator pair: under unchecked
+    shard_map a bare psum transposes to another psum, overcounting
+    row-parallel grads tp x (non-uniformly, so even Adam diverges)."""
+    from mmlspark_tpu.parallel import MODEL_AXIS
+    toks = _toks(b=8, s=32)
+    ref = ShardedLMTrainer(mesh=grid_mesh((8, 1)), **_KW)
+    want = [ref.step(toks) for _ in range(3)]
+    t3 = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 2, 2), (DATA_AXIS, PIPE_AXIS, MODEL_AXIS)),
+        n_microbatches=2, **_KW)
+    got = [t3.step(toks) for _ in range(3)]
+    assert got == pytest.approx(want, abs=2e-3)
+    # true 3D sharding: each device holds (L/pp, d, d/tp) of wq
+    wq = t3.params["layers"]["wq"]
+    assert {s.data.shape for s in wq.addressable_shards} == {(2, 32, 16)}
+    # head/d_ff divisibility enforced
+    with pytest.raises(ValueError, match="model axis"):
+        PipelinedLMTrainer(
+            mesh=grid_mesh((2, 2, 2), (DATA_AXIS, PIPE_AXIS, MODEL_AXIS)),
+            **dict(_KW, n_heads=3))
+
+
+def test_3d_with_flash_attention():
+    """flash attention inside the 3D grid: local heads per model shard run
+    the Pallas kernel (fwd + flash backward), still matching the oracle."""
+    from mmlspark_tpu.parallel import MODEL_AXIS
+    toks = _toks(b=8, s=32)
+    ref = ShardedLMTrainer(mesh=grid_mesh((8, 1)), **_KW)
+    want = [ref.step(toks) for _ in range(2)]
+    t3 = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 2, 2), (DATA_AXIS, PIPE_AXIS, MODEL_AXIS)),
+        n_microbatches=2, attention="flash", **_KW)
+    got = [t3.step(toks) for _ in range(2)]
+    assert got == pytest.approx(want, abs=2e-3)
