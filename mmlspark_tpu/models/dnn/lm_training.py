@@ -138,71 +138,95 @@ class ShardedLMTrainer:
     # The reference has nothing comparable (SURVEY §5: "no mid-training
     # checkpointing" — flagged as a must-add); step checkpoints reuse the
     # framework's atomic CheckpointManager and re-place restored leaves with
-    # the SAME sharding layout the constructor computes.
+    # the SAME sharding layout the constructor computes. The save/restore
+    # machinery is shared with PipelinedLMTrainer (one implementation, one
+    # format — see save_lm_checkpoint / restore_lm_checkpoint below).
     def save_checkpoint(self, directory: str, step: int) -> None:
-        import jax
-        from ...utils.checkpoint import CheckpointManager
-        from .model import tree_to_payload
-        params, opt_state = self.params, self.opt_state
-        if jax.process_count() > 1:
-            # multi-host: gather shards so every leaf is addressable, then
-            # write from the leader only (shared filesystem, one writer)
-            from jax.experimental import multihost_utils
-            params = multihost_utils.process_allgather(params, tiled=True)
-            opt_state = multihost_utils.process_allgather(opt_state,
-                                                          tiled=True)
-        payload = {"meta": dict(self.meta)}
-        # params: dict/list tree, serialized with its treedef. opt_state:
-        # optax NamedTuple nodes don't round-trip through the treedef
-        # string — leaves only; restore rebuilds the structure from the
-        # live optimizer state (same optimizer config = same structure)
-        payload.update(tree_to_payload(params, "p"))
-        payload.update(tree_to_payload(opt_state, "o", leaves_only=True))
-        if jax.process_index() == 0:
-            CheckpointManager(directory).save(step, payload)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"lm_ckpt_{step}")
+        save_lm_checkpoint(directory, step, self.params, self.opt_state,
+                           self.meta, tag="lm_ckpt")
 
     def restore_checkpoint(self, directory: str, step: int = None) -> int:
         """Load params + optimizer state from the latest (or given) step;
         returns the restored step. Leaves land back on the mesh with the
         live state's shardings, so the next step() resumes exactly."""
-        import jax
-        from ...utils.checkpoint import CheckpointManager
-        from .model import tree_from_payload
-        mgr = CheckpointManager(directory)
-        if step is None:
-            # resolve ONCE: the returned step must be the one actually
-            # loaded, even if a concurrent writer lands a newer step
-            step = mgr.latest_step()
-        payload = mgr.restore(step)
-        saved_meta = payload.get("meta")
-        if saved_meta is not None and dict(saved_meta) != dict(self.meta):
-            raise ValueError(
-                f"checkpoint was saved with model config {saved_meta} but "
-                f"this trainer has {dict(self.meta)} — resuming would "
-                f"silently train a different model")
-        params = tree_from_payload(payload, "p")
-        shardings = _param_shardings({"layers": params["layers"]}, self.mesh)
-        self.params = jax.tree_util.tree_map(
-            lambda a, s: jax.device_put(a, s), params, shardings,
-            is_leaf=lambda x: isinstance(x, np.ndarray))
-        # pour the saved leaves into the LIVE optimizer state's structure
-        # and shardings (no throwaway init, no unsharded materialization)
-        o_leaves = tree_from_payload(payload, "o", leaves_only=True)
-        live_leaves, structure = jax.tree_util.tree_flatten(self.opt_state)
-        if len(live_leaves) != len(o_leaves):
-            raise ValueError(
-                f"checkpoint has {len(o_leaves)} optimizer leaves but this "
-                f"trainer\'s optimizer expects {len(live_leaves)} — "
-                f"optimizer config changed since the save")
-        import jax.numpy as jnp
-        # match each live leaf's placement; an UNCOMMITTED live leaf (fresh
-        # optax init scalars) must stay uncommitted — committing it to its
-        # current single device would conflict with the sharded params in jit
-        placed = [jax.device_put(a, live.sharding)
-                  if getattr(live, "committed", False) else jnp.asarray(a)
-                  for a, live in zip(o_leaves, live_leaves)]
-        self.opt_state = jax.tree_util.tree_unflatten(structure, placed)
+        self.params, self.opt_state, step = restore_lm_checkpoint(
+            directory, step, self.params, self.opt_state, self.meta)
         return step
+
+
+def save_lm_checkpoint(directory: str, step: int, params, opt_state, meta,
+                       tag: str) -> None:
+    """Leader-only write of host-gathered leaves (shared by the GSPMD and
+    pipelined trainers — one implementation, one on-disk format)."""
+    import jax
+    from ...utils.checkpoint import CheckpointManager
+    from .model import tree_to_payload
+    if jax.process_count() > 1:
+        # multi-host: gather shards so every leaf is addressable, then
+        # write from the leader only (shared filesystem, one writer)
+        from jax.experimental import multihost_utils
+        params = multihost_utils.process_allgather(params, tiled=True)
+        opt_state = multihost_utils.process_allgather(opt_state, tiled=True)
+    payload = {"meta": dict(meta)}
+    # params: dict/list tree, serialized with its treedef. opt_state:
+    # optax NamedTuple nodes don't round-trip through the treedef
+    # string — leaves only; restore rebuilds the structure from the
+    # live optimizer state (same optimizer config = same structure)
+    payload.update(tree_to_payload(params, "p"))
+    payload.update(tree_to_payload(opt_state, "o", leaves_only=True))
+    if jax.process_index() == 0:
+        CheckpointManager(directory).save(step, payload)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"{tag}_{step}")
+
+
+def restore_lm_checkpoint(directory: str, step, live_params, live_opt_state,
+                          meta):
+    """Returns (params, opt_state, step) with every leaf re-placed onto the
+    LIVE state's shardings — works unchanged for GSPMD and pipelined
+    layouts (the live leaves carry the layout)."""
+    import jax
+    import jax.numpy as jnp
+    from ...utils.checkpoint import CheckpointManager
+    from .model import tree_from_payload
+    mgr = CheckpointManager(directory)
+    if step is None:
+        # resolve ONCE: the returned step must be the one actually
+        # loaded, even if a concurrent writer lands a newer step
+        step = mgr.latest_step()
+    payload = mgr.restore(step)
+    saved_meta = payload.get("meta")
+    if saved_meta is not None and dict(saved_meta) != dict(meta):
+        raise ValueError(
+            f"checkpoint was saved with model config {saved_meta} but "
+            f"this trainer has {dict(meta)} — resuming would "
+            f"silently train a different model")
+    params = tree_from_payload(payload, "p")
+    live_p, p_struct = jax.tree_util.tree_flatten(live_params)
+    new_p, _ = jax.tree_util.tree_flatten(params)
+    if len(new_p) != len(live_p):
+        raise ValueError(
+            f"checkpoint has {len(new_p)} parameter leaves but this "
+            f"trainer expects {len(live_p)} — it was saved by a different "
+            f"architecture or trainer layout")
+    restored_params = jax.tree_util.tree_unflatten(
+        p_struct, [jax.device_put(a, live.sharding)
+                   for a, live in zip(new_p, live_p)])
+    # pour the saved leaves into the LIVE optimizer state's structure
+    # and shardings (no throwaway init, no unsharded materialization)
+    o_leaves = tree_from_payload(payload, "o", leaves_only=True)
+    live_leaves, structure = jax.tree_util.tree_flatten(live_opt_state)
+    if len(live_leaves) != len(o_leaves):
+        raise ValueError(
+            f"checkpoint has {len(o_leaves)} optimizer leaves but this "
+            f"trainer's optimizer expects {len(live_leaves)} — "
+            f"optimizer config changed since the save")
+    # match each live leaf's placement; an UNCOMMITTED live leaf (fresh
+    # optax init scalars) must stay uncommitted — committing it to its
+    # current single device would conflict with the sharded params in jit
+    placed = [jax.device_put(a, live.sharding)
+              if getattr(live, "committed", False) else jnp.asarray(a)
+              for a, live in zip(o_leaves, live_leaves)]
+    opt_state = jax.tree_util.tree_unflatten(structure, placed)
+    return restored_params, opt_state, step

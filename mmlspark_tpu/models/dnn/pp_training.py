@@ -311,7 +311,7 @@ class PipelinedLMTrainer:
         self._step = train_step
 
     def step(self, tokens: np.ndarray) -> float:
-        """One dp x pp update; returns the batch loss."""
+        """One dp x pp (x tp) update; returns the batch loss."""
         import jax
         import jax.numpy as jnp
         from ...parallel import DATA_AXIS
@@ -326,3 +326,19 @@ class PipelinedLMTrainer:
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, tok)
         return float(loss)
+
+    # -- checkpoint/resume ---------------------------------------------------
+    # Shared implementation with ShardedLMTrainer (one format, one code
+    # path); restore re-places every leaf with the LIVE stage/tensor
+    # shardings — the live leaves carry the 3D layout — so the next step()
+    # resumes exactly.
+    def save_checkpoint(self, directory: str, step: int) -> None:
+        from .lm_training import save_lm_checkpoint
+        save_lm_checkpoint(directory, step, self.params, self.opt_state,
+                           self.meta, tag="pp_ckpt")
+
+    def restore_checkpoint(self, directory: str, step: int = None) -> int:
+        from .lm_training import restore_lm_checkpoint
+        self.params, self.opt_state, step = restore_lm_checkpoint(
+            directory, step, self.params, self.opt_state, self.meta)
+        return step
