@@ -210,6 +210,14 @@ def restore_lm_checkpoint(directory: str, step, live_params, live_opt_state,
             f"checkpoint has {len(new_p)} parameter leaves but this "
             f"trainer expects {len(live_p)} — it was saved by a different "
             f"architecture or trainer layout")
+    for i, (a, live) in enumerate(zip(new_p, live_p)):
+        # leaf-count alone misses e.g. n_layers=1 stacked-vs-list layouts;
+        # a shape check here beats an obscure in-jit rank error later
+        if tuple(np.shape(a)) != tuple(live.shape):
+            raise ValueError(
+                f"checkpoint parameter leaf {i} has shape {np.shape(a)} "
+                f"but this trainer expects {tuple(live.shape)} — saved by "
+                f"a different architecture or trainer layout")
     restored_params = jax.tree_util.tree_unflatten(
         p_struct, [jax.device_put(a, live.sharding)
                    for a, live in zip(new_p, live_p)])

@@ -86,7 +86,7 @@ def _tp_g(axis: str):
 
 
 def _block(x, lp, h: int, dh: int, attention: str = "dense",
-           tp_axis=None):
+           tp_axis=None, cp_axis=None):
     """One transformer block on a (S, d) sequence — the same math as
     transformer_apply's loop body (causal attention), kept in lockstep
     so pipelined and unpipelined losses agree bit-for-bit up to reduction
@@ -114,7 +114,17 @@ def _block(x, lp, h: int, dh: int, attention: str = "dense",
     q = (y @ lp["wq"]).reshape(seq, h, dh)
     k = (y @ lp["wk"]).reshape(seq, h, dh)
     v = (y @ lp["wv"]).reshape(seq, h, dh)
-    if attention == "flash":
+    if cp_axis is not None:
+        # context parallelism: the sequence is SHARDED over cp_axis; ring
+        # attention rotates K/V blocks around that axis with the global
+        # causal geometry carried by block offsets. attention="flash"
+        # streams each rotating block through the Pallas kernel.
+        from ...parallel.ring_attention import _ring_attention_sharded
+        a = _ring_attention_sharded(
+            q, k, v, axis_name=cp_axis, causal=True,
+            scale=1.0 / float(np.sqrt(dh)),
+            block_impl="flash" if attention == "flash" else "dense")
+    elif attention == "flash":
         from ...ops.flash_attention import flash_attention
         a = flash_attention(q, k, v, causal=True)
     else:
@@ -173,9 +183,14 @@ class PipelinedLMTrainer:
         if d_ff % tp:
             raise ValueError(
                 f"d_ff ({d_ff}) must divide by the model axis ({tp})")
+        # optional fourth axis: context parallelism — the SEQUENCE shards
+        # over it and attention runs as a ring inside each stage
+        from ...parallel import SEQ_AXIS
+        cp = mesh.shape[SEQ_AXIS] if SEQ_AXIS in mesh.axis_names else 1
         self.mesh = mesh
         self.n_stages = n_stages
         self.tp = tp
+        self.cp = cp
         self.n_microbatches = n_microbatches
 
         raw = init_transformer(vocab_size, d_model, n_heads, n_layers,
@@ -217,7 +232,9 @@ class PipelinedLMTrainer:
             lambda a, s: jax.device_put(jnp.asarray(a), s), params, shardings)
         self._opt = optax.adam(lr)
         self.opt_state = self._opt.init(self.params)
-        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+        batch_spec = (P(DATA_AXIS, SEQ_AXIS) if cp > 1
+                      else P(DATA_AXIS, None))
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
 
         h_loc = self.meta["n_heads"] // tp   # local heads per model shard
         d = self.meta["d_model"]
@@ -225,36 +242,58 @@ class PipelinedLMTrainer:
         M = n_microbatches
         S_P = n_stages
         tp_axis = MODEL_AXIS if tp > 1 else None
+        cp_axis = SEQ_AXIS if cp > 1 else None
         opt = self._opt
 
         def device_loss(p, tokens):
             """Per-device GPipe forward; returns the replicated global loss.
-            p["layers"] leaves are this stage's (L/P, ...) slice."""
+            p["layers"] leaves are this stage's (L/P, ...) slice; with cp,
+            `tokens` is also a SEQUENCE shard and positions are global."""
             s_idx = jax.lax.axis_index(PIPE_AXIS)
-            b_loc, S = tokens.shape
+            b_loc, S_loc = tokens.shape
             mb = b_loc // M
-            mbs = tokens.reshape(M, mb, S)
+            mbs = tokens.reshape(M, mb, S_loc)
+            seq_off = (jax.lax.axis_index(cp_axis) * S_loc if cp_axis
+                       else 0)
+            # next-token targets: shift by one GLOBAL position — the last
+            # local position's target is the NEXT seq shard's first token
+            # (computed once, outside the tick cond: a collective inside a
+            # cond is only safe when the whole ring agrees on the branch)
+            if cp_axis:
+                first_next = jax.lax.ppermute(
+                    mbs[:, :, 0], cp_axis,
+                    [(j, (j - 1) % cp) for j in range(cp)])
+            else:
+                first_next = mbs[:, :, 0]
+            tgt_mbs = jnp.concatenate([mbs[:, :, 1:],
+                                       first_next[:, :, None]], axis=2)
+            # the GLOBALLY last position has no target
+            is_last_shard = (jax.lax.axis_index(cp_axis) == cp - 1) \
+                if cp_axis else True
+            pos_mask = jnp.where(
+                (jnp.arange(S_loc) == S_loc - 1) & is_last_shard, 0.0, 1.0)
 
             def apply_stage(x):      # (mb, S, d) through this stage's layers
                 def one_layer(h_x, lp):
                     return jax.vmap(lambda xx: _block(
                         xx, lp, h_loc, dh, attention=attention,
-                        tp_axis=tp_axis))(h_x), None
+                        tp_axis=tp_axis, cp_axis=cp_axis))(h_x), None
                 x, _ = jax.lax.scan(one_layer, x, p["layers"])
                 return x
 
             def embed_mb(tok):       # (mb, S) -> (mb, S, d)
-                return p["embed"][tok] + p["pos"][:S]
+                pos = jax.lax.dynamic_slice_in_dim(
+                    p["pos"], seq_off, S_loc, axis=0)
+                return p["embed"][tok] + pos
 
-            def mb_loss(y, tok):     # final-stage head on (mb, S, d)
+            def mb_loss(y, tgt):     # final-stage head: local masked SUM
                 from .transformer import _layer_norm
                 z = _layer_norm(y, p["final_ln"])
                 logits = z @ p["embed"].T
-                logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-                tgt = tok[:, 1:]
+                logp = jax.nn.log_softmax(logits, axis=-1)
                 nll = -jnp.take_along_axis(logp, tgt[..., None],
                                            axis=-1)[..., 0]
-                return nll.mean()
+                return (nll * pos_mask).sum()
 
             def tick(carry, t):
                 act, acc = carry
@@ -269,20 +308,25 @@ class PipelinedLMTrainer:
                 out_idx = t - (S_P - 1)
                 valid = ((out_idx >= 0) & (out_idx < M)
                          & (s_idx == S_P - 1))
-                tok_out = mbs[jnp.clip(out_idx, 0, M - 1)]
+                tgt_out = tgt_mbs[jnp.clip(out_idx, 0, M - 1)]
                 acc = acc + jax.lax.cond(
-                    valid, lambda: mb_loss(y, tok_out), lambda: 0.0)
+                    valid, lambda: mb_loss(y, tgt_out), lambda: 0.0)
                 act = jax.lax.ppermute(
                     y, PIPE_AXIS,
                     [(i, (i + 1) % S_P) for i in range(S_P)])
                 return (act, acc), None
 
-            act0 = jnp.zeros((mb, S, d), jnp.float32)
+            act0 = jnp.zeros((mb, S_loc, d), jnp.float32)
             (_, acc), _ = jax.lax.scan(tick, (act0, jnp.float32(0.0)),
                                        jnp.arange(M + S_P - 1))
-            # loss lives on the last stage; replicate over pipe, average dp
-            loss = jax.lax.psum(acc, PIPE_AXIS) / M
-            return jax.lax.pmean(loss, DATA_AXIS)
+            # loss lives on the last stage; sum over pipe and (g-operator,
+            # identity backward) over seq shards, normalize by the global
+            # valid-position count, average dp
+            loss = jax.lax.psum(acc, PIPE_AXIS)
+            if cp_axis:
+                loss = _tp_g(cp_axis)(loss)
+            denom = M * mb * (S_loc * cp - 1)
+            return jax.lax.pmean(loss / denom, DATA_AXIS)
 
         def fwd_bwd(p, tokens):
             loss, grads = jax.value_and_grad(device_loss)(p, tokens)
@@ -291,6 +335,11 @@ class PipelinedLMTrainer:
             # pipe (each stage computed grads for its own use of them)
             grads = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, DATA_AXIS), grads)
+            if cp_axis:
+                # every leaf's grad covers only the local sequence shard's
+                # positions: sum the partitions
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, cp_axis), grads)
             rep = {k: jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(g, PIPE_AXIS), grads[k])
                 for k in ("embed", "pos", "final_ln")}
@@ -299,7 +348,7 @@ class PipelinedLMTrainer:
 
         mapped = shard_map(
             fwd_bwd, mesh=mesh,
-            in_specs=(self._param_specs, P(DATA_AXIS, None)),
+            in_specs=(self._param_specs, batch_spec),
             out_specs=(P(), self._param_specs), check_rep=False)
 
         @jax.jit
@@ -321,6 +370,10 @@ class PipelinedLMTrainer:
             raise ValueError(
                 f"batch {B} must divide by dp*microbatches = "
                 f"{dp * self.n_microbatches}")
+        if tokens.shape[1] % self.cp:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} must divide by the "
+                f"seq axis ({self.cp})")
         tok = jax.device_put(jnp.asarray(tokens, jnp.int32),
                              self._batch_sharding)
         self.params, self.opt_state, loss = self._step(

@@ -171,3 +171,42 @@ def test_restore_refuses_foreign_layout(tmp_path):
         n_microbatches=2, **_KW)
     with pytest.raises(ValueError, match="parameter leaves"):
         t_p.restore_checkpoint(str(tmp_path))
+
+
+def test_4d_dp_pp_tp_cp_parity():
+    """The FULL composition — data x pipeline x tensor x context (ring
+    attention over sequence shards) in ONE shard_map — must reproduce the
+    dp-only oracle. Covers the cross-shard pieces individually easy to get
+    wrong: ring causal offsets, next-token targets crossing sequence
+    shards (ppermute'd first token), global position embeddings, and the
+    per-axis gradient collectives."""
+    from mmlspark_tpu.parallel import MODEL_AXIS, SEQ_AXIS
+    toks = _toks(b=8, s=32)
+    ref = ShardedLMTrainer(mesh=grid_mesh((8, 1)), **_KW)
+    want = [ref.step(toks) for _ in range(3)]
+    axes = (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS)
+    for shape in [(1, 1, 1, 8), (1, 2, 1, 4), (2, 2, 1, 2), (1, 2, 2, 2)]:
+        t = PipelinedLMTrainer(mesh=grid_mesh(shape, axes),
+                               n_microbatches=2, **_KW)
+        got = [t.step(toks) for _ in range(3)]
+        assert got == pytest.approx(want, abs=2e-3), shape
+
+
+def test_4d_flash_blocks_inside_ring():
+    """attention='flash' with a seq axis streams each ROTATING ring block
+    through the Pallas kernel — flash within the device, ppermute across
+    the ring, GPipe across stages, Megatron across tensor shards, all in
+    one program; still oracle-exact."""
+    from mmlspark_tpu.parallel import MODEL_AXIS, SEQ_AXIS
+    toks = _toks(b=8, s=32)
+    ref = ShardedLMTrainer(mesh=grid_mesh((8, 1)), **_KW)
+    want = [ref.step(toks) for _ in range(2)]
+    t = PipelinedLMTrainer(
+        mesh=grid_mesh((1, 2, 2, 2),
+                       (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS)),
+        n_microbatches=2, attention="flash", **_KW)
+    got = [t.step(toks) for _ in range(2)]
+    assert got == pytest.approx(want, abs=2e-3)
+    # ragged sequence vs the seq axis is refused clearly
+    with pytest.raises(ValueError, match="seq axis"):
+        t.step(_toks(b=8, s=31))
