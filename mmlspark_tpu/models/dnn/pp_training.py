@@ -144,12 +144,21 @@ def _block(x, lp, h: int, dh: int, attention: str = "dense",
 
 
 class PipelinedLMTrainer:
-    """dp x pp trainer: params live stage-sharded, one jitted train step.
+    """dp x pp (x tp) (x cp) trainer: one jitted shard_map train step.
 
-    Usage:
-        mesh = grid_mesh((dp, pp), (DATA_AXIS, PIPE_AXIS))
-        t = PipelinedLMTrainer(vocab, mesh=mesh, n_microbatches=4, ...)
-        loss = t.step(tokens)   # (B, S) int32; B % (dp * n_microbatches) == 0
+    The mesh's axes pick the composition — every combination is
+    oracle-parity-tested (tests/test_pp_training.py):
+
+        grid_mesh((dp, pp), (DATA_AXIS, PIPE_AXIS))                # 2D
+        grid_mesh((dp, pp, tp), (..., MODEL_AXIS))                 # 3D
+        grid_mesh((dp, pp, tp, cp), (..., SEQ_AXIS))               # 4D
+
+    Layers stack-shard over PIPE (GPipe microbatch schedule, one ppermute
+    per tick); weights Megatron-shard over MODEL (f/g operators); the
+    SEQUENCE shards over SEQ with ring attention (attention="flash"
+    streams rotating K/V blocks through the Pallas kernel + its flash
+    backward). loss = t.step(tokens): (B, S) int32,
+    B % (dp * n_microbatches) == 0, S % cp == 0.
     """
 
     def __init__(self, vocab_size: int, mesh=None, n_microbatches: int = 4,
