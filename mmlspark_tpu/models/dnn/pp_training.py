@@ -1,7 +1,7 @@
-"""Pipeline-parallel LM training: GPipe microbatching over a `pipe` mesh axis.
-
-The last named parallelism strategy from SURVEY §2.10 (TP: lm_training.py,
-CP: parallel/ring_attention.py — PP completes the set). TPU-native design:
+"""Pipeline-parallel LM training: GPipe microbatching over a `pipe` mesh
+axis, composing up to FULL 4D — dp x pp x tp x cp — in one shard_map
+(SURVEY §2.10: TP, PP and CP all implemented AND composed here).
+TPU-native design:
 
 - The transformer's layers are STACKED on a leading axis and sharded over
   the `pipe` mesh axis — each device materializes only its stage's layers
@@ -13,8 +13,11 @@ CP: parallel/ring_attention.py — PP completes the set). TPU-native design:
   ppermute gives the reverse schedule for free — the transpose of a
   ppermute is the reverse ppermute, so backward activations flow s+1 -> s
   with no hand-written bubble bookkeeping.
-- Composable with dp: mesh ("data", "pipe"); the batch shards over `data`,
-  every data-slice runs its own pipeline, gradients pmean over `data`.
+- Composable axes: batch over "data" (grads pmean), Megatron tensor
+  slices over "model" (f/g operators below), and sequence shards over
+  "seq" (ring attention with global causal offsets; cross-shard
+  next-token targets by ppermute). Any subset of axes works — see the
+  PipelinedLMTrainer docstring.
 
 The reference has no sequence models at all (SURVEY §5) — this file exists
 because long-context/distributed training is first-class in the TPU build,
@@ -369,7 +372,7 @@ class PipelinedLMTrainer:
         self._step = train_step
 
     def step(self, tokens: np.ndarray) -> float:
-        """One dp x pp (x tp) update; returns the batch loss."""
+        """One dp x pp (x tp) (x cp) update; returns the batch loss."""
         import jax
         import jax.numpy as jnp
         from ...parallel import DATA_AXIS
