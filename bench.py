@@ -181,6 +181,29 @@ def _bench_resnet():
                       "vs_baseline": 0.0}))
 
 
+def _bench_lm_long_context():
+    """16k-token causal LM training step (README long-context row's
+    source): flash fwd+bwd through the pipelined trainer, one chip."""
+    import jax
+    from mmlspark_tpu.parallel import DATA_AXIS, PIPE_AXIS, grid_mesh
+    from mmlspark_tpu.models.dnn.pp_training import PipelinedLMTrainer
+    t = PipelinedLMTrainer(
+        vocab_size=4096, mesh=grid_mesh((1, 1), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=1, d_model=512, n_heads=8, n_layers=4, d_ff=1024,
+        max_len=16384, attention="flash", seed=0)
+    toks = np.random.default_rng(0).integers(
+        0, 4096, size=(1, 16384)).astype(np.int32)
+    l1 = t.step(toks)                      # compile + first step
+    t0 = time.time()
+    l2 = t.step(toks)
+    dt = time.time() - t0
+    print(json.dumps({
+        "metric": "lm_train_step_16k_tokens_s", "value": round(dt, 2),
+        "unit": "s/step", "vs_baseline": 0.0,
+        "loss_step1": round(float(l1), 3), "loss_step2": round(float(l2), 3),
+        "model": "4L d=512 8h flash fwd+bwd"}))
+
+
 def main():
     import jax
     # persistent compilation cache: later rounds skip the multi-minute
@@ -197,6 +220,8 @@ def main():
         return _bench_flash()
     if mode == "resnet":
         return _bench_resnet()
+    if mode == "lm":
+        return _bench_lm_long_context()
     # predict/shap modes never print the bandwidth fields — don't spend the
     # ~40 timed 1 GiB copy passes measuring one
     copy_gbps = (0.0 if mode in ("predict", "shap")
