@@ -167,9 +167,12 @@ class PipelinedLMTrainer:
     def __init__(self, vocab_size: int, mesh=None, n_microbatches: int = 4,
                  d_model: int = 128, n_heads: int = 8, n_layers: int = 4,
                  d_ff: int = 256, max_len: int = 512, lr: float = 1e-3,
-                 seed: int = 0, attention: str = "dense"):
+                 seed: int = 0, attention: str = "dense",
+                 optimizer: str = "adam"):
         if attention not in ("dense", "flash"):
             raise ValueError("attention must be dense|flash")
+        if optimizer not in ("adam", "sgd"):
+            raise ValueError("optimizer must be adam|sgd")
         import jax
         import jax.numpy as jnp
         import optax
@@ -242,7 +245,11 @@ class PipelinedLMTrainer:
             is_leaf=lambda x: isinstance(x, P))
         self.params = jax.tree_util.tree_map(
             lambda a, s: jax.device_put(jnp.asarray(a), s), params, shardings)
-        self._opt = optax.adam(lr)
+        # sgd exists for gradient-PARITY testing: Adam is invariant to
+        # uniform gradient scaling, so only a scale-sensitive optimizer can
+        # detect a collective-transpose overcount (e.g. a bare psum over
+        # the pipe axis scaling every grad by pp)
+        self._opt = optax.adam(lr) if optimizer == "adam" else optax.sgd(lr)
         self.opt_state = self._opt.init(self.params)
         batch_spec = (P(DATA_AXIS, SEQ_AXIS) if cp > 1
                       else P(DATA_AXIS, None))
@@ -331,10 +338,13 @@ class PipelinedLMTrainer:
             act0 = jnp.zeros((mb, S_loc, d), jnp.float32)
             (_, acc), _ = jax.lax.scan(tick, (act0, jnp.float32(0.0)),
                                        jnp.arange(M + S_P - 1))
-            # loss lives on the last stage; sum over pipe and (g-operator,
-            # identity backward) over seq shards, normalize by the global
-            # valid-position count, average dp
-            loss = jax.lax.psum(acc, PIPE_AXIS)
+            # loss lives on the last stage; g-operator (psum forward,
+            # IDENTITY backward) over BOTH pipe and seq shards — a bare
+            # psum's transpose under check_rep=False is another psum, which
+            # would scale every parameter gradient by the pipe degree
+            # (Adam masks it; SGD/weight-decay/grad-clip would not).
+            # Normalize by the global valid-position count, average dp.
+            loss = _tp_g(PIPE_AXIS)(acc)
             if cp_axis:
                 loss = _tp_g(cp_axis)(loss)
             denom = M * mb * (S_loc * cp - 1)
@@ -343,8 +353,10 @@ class PipelinedLMTrainer:
         def fwd_bwd(p, tokens):
             loss, grads = jax.value_and_grad(device_loss)(p, tokens)
             # dp gradient all-reduce; stage-sharded layer grads stay local
-            # to their pipe coordinate, replicated leaves also pmean over
-            # pipe (each stage computed grads for its own use of them)
+            # to their pipe coordinate; replicated leaves (embed/pos/
+            # final_ln) are psum'd over pipe below — each stage holds a
+            # DISJOINT partial (embed grads come only from stages 0 and
+            # P-1), so the SUM is required, not a mean
             grads = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, DATA_AXIS), grads)
             if cp_axis:

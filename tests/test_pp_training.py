@@ -37,6 +37,36 @@ def test_dp_pp_loss_parity_with_unpipelined():
     assert last < l_pp
 
 
+def test_sgd_gradient_parity_across_pp_degrees():
+    """DIRECT gradient parity (not just Adam loss trajectories, which are
+    invariant to uniform gradient scaling): one SGD step at pp=1 / pp=2 /
+    pp=4 from identical init must land on IDENTICAL parameters. A bare
+    psum over the pipe axis in the loss reduction would transpose to a
+    second psum and scale every gradient by pp — Adam masks that exactly;
+    SGD params diverge by (pp-1) x lr x grad on step one."""
+    toks = _toks(b=32)
+    kw = dict(_KW, lr=1e-2)
+
+    def params_after_steps(pp_deg, n=2):
+        t = PipelinedLMTrainer(
+            mesh=grid_mesh((8 // pp_deg, pp_deg), (DATA_AXIS, PIPE_AXIS)),
+            n_microbatches=4, optimizer="sgd", **kw)
+        for _ in range(n):
+            t.step(toks)
+        import jax
+        return jax.device_get(t.params)
+
+    ref = params_after_steps(1)
+    for pp_deg in (2, 4):
+        got = params_after_steps(pp_deg)
+        for name in ("embed", "pos"):
+            np.testing.assert_allclose(got[name], ref[name], atol=2e-6,
+                                       err_msg=f"pp={pp_deg} {name}")
+        np.testing.assert_allclose(
+            got["layers"]["wq"], ref["layers"]["wq"], atol=2e-6,
+            err_msg=f"pp={pp_deg} wq")
+
+
 def test_pure_pp_and_microbatch_counts():
     """1 x 8 pure pipeline (every device one layer) with M > P and M == P;
     both must agree with the dp-only oracle."""
