@@ -128,6 +128,103 @@ def test_flash_backward_matches_dense_gradients():
             assert rel < 2e-4, (s, sk, causal, name, rel)
 
 
+def test_auto_block_selection():
+    """_auto_blocks: long sequences get 1024-wide blocks (grid-cell
+    overhead dominates below that on v5e), mid-length sequences cap the
+    block so padding waste stays under 20%, and the f32 backward caps at
+    512 (1024 f32 operand blocks exceed VMEM)."""
+    from mmlspark_tpu.ops.flash_attention import _auto_blocks
+    assert _auto_blocks(16384, 16384, jnp.bfloat16) == (1024, 1024, 1024,
+                                                        1024)
+    assert _auto_blocks(16384, 16384, jnp.float32) == (1024, 1024, 512, 512)
+    # S=1100 at block 1024 would pad to 2048 (46% waste) -> falls to 256
+    bq, bk, _, _ = _auto_blocks(1100, 1100, jnp.float32)
+    assert (bq, bk) == (256, 256)
+    # S=1536 is exactly 3x512: 512 wins over 256
+    assert _auto_blocks(1536, 1536, jnp.float32)[0] == 512
+    assert _auto_blocks(300, 300, jnp.float32)[0] == 256
+
+
+def test_bf16_operands_fwd_and_grad():
+    """bf16 inputs run the matmuls in bf16 (input dtype) with f32
+    accumulation, at sequence lengths long enough to take the AUTO 1024
+    blocks and the maskless interior fast path. Interpret mode executes
+    the same program CI-side; tolerance is the bf16 rounding band."""
+    rng = np.random.default_rng(5)
+    s, h, d = 2048, 2, 64
+    qf = rng.normal(size=(s, h, d)).astype(np.float32)
+    kf = rng.normal(size=(s, h, d)).astype(np.float32)
+    vf = rng.normal(size=(s, h, d)).astype(np.float32)
+    q, k, v = (jnp.asarray(a, jnp.bfloat16) for a in (qf, kf, vf))
+    out = flash_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = reference_attention(jnp.asarray(qf), jnp.asarray(kf),
+                              jnp.asarray(vf), causal=True)
+    rel = float(jnp.abs(out.astype(jnp.float32) - ref).max() /
+                (jnp.abs(ref).max() + 1e-9))
+    assert rel < 3e-2, rel
+
+    # gradients through the bf16 backward kernels (ds/p down-casts)
+    g = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: reference_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(jnp.asarray(qf), jnp.asarray(kf),
+                           jnp.asarray(vf))
+    for name, a, b in zip("qkv", g, gr):
+        assert a.dtype == jnp.bfloat16, name
+        rel = float(jnp.abs(a.astype(jnp.float32) - b).max() /
+                    (jnp.abs(b).max() + 1e-9))
+        assert rel < 5e-2, (name, rel)
+
+
+def test_stats_flash_backward_matches_dense_reference():
+    """flash_attention_stats' VJP is now FLASH (O(block), lse := m,
+    dsum := -dl). Against the dense XLA reference it must agree exactly
+    for a SHIFT-INVARIANT consumer (the contract — the ring merge's
+    weights cancel the reference shift), across causal offsets including
+    partially- and fully-masked blocks."""
+    from mmlspark_tpu.ops.flash_attention import (_stats_xla_reference,
+                                                  flash_attention_stats)
+    rng = np.random.default_rng(3)
+    s, h, d = 300, 2, 64
+
+    for q_off, k_off, causal in [(0, 0, True), (0, 0, False),
+                                 (s, 0, True),      # fully visible block
+                                 (128, 0, True)]:   # diagonal crosses block
+        q = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+
+        def consumer(acc, m, l):
+            # ring-merge-shaped shift-invariant readout: weight e^{m-c}
+            # rescales acc/l back to a fixed reference c=0, flagged rows
+            # (m == -1e30) fold to zero weight exactly like the ring
+            wgt = jnp.exp(jnp.minimum(m, 50.0))            # (H, S)
+            acc_h = jnp.moveaxis(acc, 0, 1)                # (H, S, D)
+            num = acc_h * wgt[..., None]
+            den = l * wgt + 1e-9
+            return (jnp.moveaxis(num / den[..., None], 0, 1) * w).sum()
+
+        def loss_flash(q, k, v):
+            return consumer(*flash_attention_stats(
+                q, k, v, q_offset=q_off, k_offset=k_off, causal=causal,
+                scale=0.125))
+
+        def loss_dense(q, k, v):
+            return consumer(*_stats_xla_reference(
+                q, k, v, q_off, k_off, causal, 0.125))
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gd):
+            rel = float(jnp.abs(a - b).max()) / (float(jnp.abs(b).max())
+                                                 + 1e-9)
+            assert rel < 2e-4, (q_off, k_off, causal, name, rel)
+
+
 def test_flash_backward_through_jit_and_composition():
     """grad-of-jit over a small transformer-block-like composition: the
     custom VJP must thread through scan/jit without shape surprises."""
