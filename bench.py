@@ -123,14 +123,32 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
     return out, booster, x
 
 
+V5E_BF16_PEAK_TFLOPS = 197.0  # chip spec; fraction-of-peak anchor
+
+
 def _bench_flash():
     """16k-token causal flash attention (README flash row's source):
-    f32 and bf16 operand timings via chained in-graph repetition."""
+    fwd and fwd+bwd timings + TFLOP/s + fraction of bf16 peak, against a
+    dense-XLA fwd baseline on identical inputs. vs_baseline is the
+    flash-over-dense forward speedup (>1 means flash wins)."""
     import jax
     import jax.numpy as jnp
-    from mmlspark_tpu.ops.flash_attention import flash_attention
+    from mmlspark_tpu.ops.flash_attention import (flash_attention,
+                                                  _xla_reference_shd)
     rng = np.random.default_rng(0)
     s, h, d = 16384, 8, 64
+    reps_n = 25
+    # useful causal FLOPs: 2 matmuls x 2*S^2*D*H, halved by causality;
+    # backward re-does ~2.5x the forward matmul work (dq + dk/dv kernels)
+    flops_fwd = 2 * 2 * s * s * d * h / 2
+
+    def timed(fn, *args):
+        float(fn(*args))                # compile + warm
+        t0 = time.time()
+        float(fn(*args))
+        # 25 in-graph reps amortize the tunnel's ~100 ms dispatch+fetch
+        return (time.time() - t0) / reps_n * 1000
+
     out = {}
     for name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
         q = jnp.asarray(rng.normal(size=(s, h, d)), dt)
@@ -138,20 +156,59 @@ def _bench_flash():
         v = jnp.asarray(rng.normal(size=(s, h, d)), dt)
 
         @jax.jit
-        def reps(q, k, v):
+        def fwd(q, k, v):
             def body(c, i):
                 o = flash_attention(q * (1 + i * 1e-6), k, v, causal=True)
                 return c + o.astype(jnp.float32).sum(), None
-            s_, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(25))
+            s_, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(reps_n))
             return s_
-        float(reps(q, k, v))            # compile + warm
-        t0 = time.time()
-        float(reps(q, k, v))
-        # 25 in-graph reps amortize the tunnel's ~100 ms dispatch+fetch
-        out[name + "_ms"] = round((time.time() - t0) / 25 * 1000, 1)
-    print(json.dumps({"metric": "flash_attention_16k_causal",
-                      "value": out["bf16_ms"], "unit": "ms",
-                      "vs_baseline": 0.0, **out}))
+
+        @jax.jit
+        def fwdbwd(q, k, v):
+            def loss(q, k, v):
+                return flash_attention(q, k, v, causal=True).astype(
+                    jnp.float32).sum()
+
+            def body(c, i):
+                l, gs = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+                    q * (1 + i * 1e-6), k, v)
+                return c + l + sum(g.astype(jnp.float32).sum()
+                                   for g in gs), None
+            s_, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(reps_n))
+            return s_
+
+        out[name + "_ms"] = round(timed(fwd, q, k, v), 1)
+        out[name + "_fwdbwd_ms"] = round(timed(fwdbwd, q, k, v), 1)
+
+    # dense XLA forward on the SAME inputs (bf16): the "just let XLA do it"
+    # alternative; 16k is near its HBM ceiling (the (S,S) f32 score matrix
+    # alone is 1 GiB x reads+writes per rep)
+    q = jnp.asarray(rng.normal(size=(s, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(s, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(s, h, d)), jnp.bfloat16)
+
+    @jax.jit
+    def dense(q, k, v):
+        def body(c, i):
+            o = _xla_reference_shd(
+                jnp.moveaxis(q * (1 + i * 1e-6), 1, 0),
+                jnp.moveaxis(k, 1, 0), jnp.moveaxis(v, 1, 0),
+                True, 1.0 / np.sqrt(d))
+            return c + o.astype(jnp.float32).sum(), None
+        s_, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(reps_n))
+        return s_
+    out["dense_xla_bf16_ms"] = round(timed(dense, q, k, v), 1)
+
+    tflops = flops_fwd / out["bf16_ms"] / 1e9
+    print(json.dumps({
+        "metric": "flash_attention_16k_causal",
+        "value": out["bf16_ms"], "unit": "ms",
+        "vs_baseline": round(out["dense_xla_bf16_ms"] / out["bf16_ms"], 2),
+        "tflops_fwd": round(tflops, 1),
+        "fraction_of_bf16_peak": round(tflops / V5E_BF16_PEAK_TFLOPS, 3),
+        "tflops_fwdbwd": round(3.5 * flops_fwd / out["bf16_fwdbwd_ms"] / 1e9,
+                               1),
+        **out}))
 
 
 def _bench_resnet():
@@ -182,35 +239,94 @@ def _bench_resnet():
 
 
 def _bench_lm_long_context():
-    """16k-token causal LM training step (README long-context row's
-    source): flash fwd+bwd through the pipelined trainer, one chip."""
+    """16k-context causal LM training step (README long-context row's
+    source): a ~220M-param GPT-2-medium-class model (12L, d=1024, 8 heads
+    of d_head=128, ff=4096, 32k vocab), bf16 mixed precision + remat +
+    flash fwd/bwd through the pipelined trainer, one chip. Prints
+    tokens/s, model FLOPs per step, and MFU against the chip's bf16 peak.
+
+    MFU accounting (standard: model FLOPs only, remat recompute NOT
+    credited): fwd matmul FLOPs = 2*T*P_matmul + 2*T*d*V (logits)
+    + L*2*S*S*d (causal attention, QK^T and PV at half the S^2 square),
+    training = 3x fwd. Override shape via BENCH_LM_* env vars."""
     import jax
     from mmlspark_tpu.parallel import DATA_AXIS, PIPE_AXIS, grid_mesh
     from mmlspark_tpu.models.dnn.pp_training import PipelinedLMTrainer
+    L = int(os.environ.get("BENCH_LM_LAYERS", 12))
+    D = int(os.environ.get("BENCH_LM_DMODEL", 1024))
+    H = int(os.environ.get("BENCH_LM_HEADS", 8))
+    FF = int(os.environ.get("BENCH_LM_DFF", 4096))
+    V = int(os.environ.get("BENCH_LM_VOCAB", 32768))
+    S = int(os.environ.get("BENCH_LM_SEQ", 16384))
+    mesh_kind = os.environ.get("BENCH_LM_MESH", "2d")
+    if mesh_kind == "4d":
+        # round-3 verdict item 9: the FULL sharded 4D program — GPipe
+        # ticks + Megatron f/g psums + ring attention with the flash
+        # stats backward — compiled and executed at realistic shape on
+        # the real chip via a degenerate 1x1x1x1 mesh (axis PRESENCE
+        # activates every code path; singleton collectives are identity).
+        # Proves the 4D composition fits HBM/VMEM at d>=1024 / 16k ctx,
+        # which the d=32 dryrun could not.
+        from mmlspark_tpu.parallel import MODEL_AXIS, SEQ_AXIS
+        mesh = grid_mesh((1, 1, 1, 1),
+                         (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS))
+    else:
+        mesh = grid_mesh((1, 1), (DATA_AXIS, PIPE_AXIS))
     t = PipelinedLMTrainer(
-        vocab_size=4096, mesh=grid_mesh((1, 1), (DATA_AXIS, PIPE_AXIS)),
-        n_microbatches=1, d_model=512, n_heads=8, n_layers=4, d_ff=1024,
-        max_len=16384, attention="flash", seed=0)
+        vocab_size=V, mesh=mesh,
+        n_microbatches=1, d_model=D, n_heads=H, n_layers=L, d_ff=FF,
+        max_len=S, attention="flash", seed=0,
+        compute_dtype="bfloat16", remat=True)
+    n_params = sum(int(np.prod(a.shape))
+                   for a in jax.tree_util.tree_leaves(t.params))
     toks = np.random.default_rng(0).integers(
-        0, 4096, size=(1, 16384)).astype(np.int32)
+        0, V, size=(1, S)).astype(np.int32)
     l1 = t.step(toks)                      # compile + first step
+    # chain steps WITHOUT a per-step loss fetch (each fetch pays the
+    # tunnel's ~100 ms round trip); one sync at the end. One more UNTIMED
+    # step first: the donated outputs of step 1 carry steady-state buffer
+    # layouts, and the first call on them compiles a second executable
+    # (~seconds) that must not land inside the timed region.
+    import jax.numpy as jnp
+    tok_dev = jax.device_put(jnp.asarray(toks, jnp.int32),
+                             t._batch_sharding)
+    t.params, t.opt_state, loss = t._step(t.params, t.opt_state, tok_dev)
+    float(loss)                            # drain the queue before timing
+    reps = 5
     t0 = time.time()
-    l2 = t.step(toks)
-    dt = time.time() - t0
+    for _ in range(reps):
+        t.params, t.opt_state, loss = t._step(t.params, t.opt_state,
+                                              tok_dev)
+    l2 = float(loss)
+    dt = (time.time() - t0) / reps
+    mm_params = L * (4 * D * D + 2 * D * FF)
+    flops_fwd = 2 * S * mm_params + 2 * S * D * V + L * 2 * S * S * D
+    flops_step = 3 * flops_fwd
+    mfu = flops_step / dt / (V5E_BF16_PEAK_TFLOPS * 1e12)
     print(json.dumps({
-        "metric": "lm_train_step_16k_tokens_s", "value": round(dt, 2),
-        "unit": "s/step", "vs_baseline": 0.0,
-        "loss_step1": round(float(l1), 3), "loss_step2": round(float(l2), 3),
-        "model": "4L d=512 8h flash fwd+bwd"}))
+        "metric": "lm_train_step_16k_tokens_s", "value": round(dt, 3),
+        "unit": "s/step", "vs_baseline": round(mfu, 4),
+        "tokens_per_sec": round(S / dt, 1),
+        "model_params": n_params,
+        "model_flops_per_step": flops_step,
+        "mfu_vs_bf16_peak": round(mfu, 4),
+        "loss_step1": round(float(l1), 3), "loss_last": round(float(l2), 3),
+        "mesh": mesh_kind,
+        "model": f"{L}L d={D} {H}h ff={FF} V={V} bf16+remat+flash"}))
 
 
 def main():
     import jax
     # persistent compilation cache: later rounds skip the multi-minute
-    # XLA compile of the fused boosting scan
+    # XLA compile of the fused boosting scan. Namespaced by host-CPU
+    # fingerprint (shared helper with tests/conftest.py): executables
+    # cached on a host with a different vector ISA abort when loaded.
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(__file__), ".jax_cache"))
+        from mmlspark_tpu.utils.hostcache import host_cache_dir
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            host_cache_dir(os.path.join(os.path.dirname(__file__),
+                                        ".jax_cache")))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass

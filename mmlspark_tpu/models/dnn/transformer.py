@@ -61,10 +61,16 @@ def init_transformer(vocab_size: int, d_model: int = 256, n_heads: int = 8,
 
 
 def _layer_norm(x, p):
+    """Layer norm with f32 statistics regardless of activation dtype
+    (bf16 mean/variance accumulation loses ~3 decimal digits at d>=1024);
+    the result is cast back to the activation dtype. For f32 activations
+    this is bit-identical to computing in place."""
     import jax.numpy as jnp
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) / jnp.sqrt(var + 1e-6) * p["scale"] + p["bias"]
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    out = (xf - mu) / jnp.sqrt(var + 1e-6) * p["scale"] + p["bias"]
+    return out.astype(x.dtype)
 
 
 def transformer_apply(params: dict, tokens, causal: bool = False,
@@ -79,9 +85,12 @@ def transformer_apply(params: dict, tokens, causal: bool = False,
     key_mask: (seq,) bool excluding padding keys from attention (dense only;
     the sequence-parallel paths take exact-length documents).
     attention_dtype: cast q/k/v to this dtype for the attention op (e.g.
-    jnp.bfloat16 — the flash kernel runs bf16 operands ~1.4x faster on
-    v5e). Scores and softmax accumulation stay f32 on every path (dense,
-    flash, ring, ulysses); the output is cast back to the residual dtype.
+    jnp.bfloat16 — measured on v5e at 16k causal, BENCH_MODE=flash: bf16
+    operands run the flash forward ~1.1x and fwd+bwd ~1.5x faster than
+    f32, the backward gap coming from the larger VMEM blocks bf16
+    affords). Scores and softmax accumulation stay f32 on every path
+    (dense, flash, ring, ulysses); the output is cast back to the
+    residual dtype.
     """
     import jax
     import jax.numpy as jnp
@@ -153,7 +162,8 @@ class TransformerSentenceEncoder(Model, HasInputCol, HasOutputCol):
     attention_dtype = Param(
         "attention_dtype",
         "cast q/k/v to this dtype inside encode_long's attention "
-        "(bfloat16 runs the flash kernel ~1.4x faster on v5e; softmax "
+        "(bfloat16 runs the flash forward ~1.1x faster than f32 on v5e, "
+        "measured at 16k causal via BENCH_MODE=flash; softmax "
         "accumulation stays f32 on every path)", None,
         validator=one_of(None, "bfloat16", "float32"))
 

@@ -39,32 +39,60 @@ def test_dp_pp_loss_parity_with_unpipelined():
 
 def test_sgd_gradient_parity_across_pp_degrees():
     """DIRECT gradient parity (not just Adam loss trajectories, which are
-    invariant to uniform gradient scaling): one SGD step at pp=1 / pp=2 /
+    invariant to uniform gradient scaling): SGD steps at pp=1 / pp=2 /
     pp=4 from identical init must land on IDENTICAL parameters. A bare
     psum over the pipe axis in the loss reduction would transpose to a
-    second psum and scale every gradient by pp — Adam masks that exactly;
-    SGD params diverge by (pp-1) x lr x grad on step one."""
-    toks = _toks(b=32)
-    kw = dict(_KW, lr=1e-2)
+    second psum and scale every gradient by the PIPE DEGREE — Adam masks
+    exactly this; SGD params diverge by lr x grad x (pp-1) on step one.
 
-    def params_after_steps(pp_deg, n=2):
-        t = PipelinedLMTrainer(
-            mesh=grid_mesh((8 // pp_deg, pp_deg), (DATA_AXIS, PIPE_AXIS)),
-            n_microbatches=4, optimizer="sgd", **kw)
-        for _ in range(n):
-            t.step(toks)
-        import jax
-        return jax.device_get(t.params)
+    Runs in a FRESH SUBPROCESS (the test_multiprocess pattern): on this
+    repo's 1-core CI host, XLA:CPU's in-process collectives deadlock
+    (0-CPU hang at the loss fetch, rendezvous threads never all arrive)
+    when this particular multi-trainer program set compiles late in a
+    300-test process — reproducibly fine in a fresh process, where it
+    runs in ~30 s. Production is TPU; the subprocess keeps the
+    gradient-parity coverage without tripping the host quirk."""
+    import subprocess
+    import sys
+    body = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np
+from mmlspark_tpu.parallel import DATA_AXIS, PIPE_AXIS, grid_mesh
+from mmlspark_tpu.models.dnn.pp_training import PipelinedLMTrainer
 
-    ref = params_after_steps(1)
-    for pp_deg in (2, 4):
-        got = params_after_steps(pp_deg)
-        for name in ("embed", "pos"):
-            np.testing.assert_allclose(got[name], ref[name], atol=2e-6,
-                                       err_msg=f"pp={pp_deg} {name}")
-        np.testing.assert_allclose(
-            got["layers"]["wq"], ref["layers"]["wq"], atol=2e-6,
-            err_msg=f"pp={pp_deg} wq")
+KW = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+          max_len=32, lr=1e-2, seed=0, optimizer="sgd")
+toks = np.random.default_rng(0).integers(0, 64, size=(32, 16)).astype(np.int32)
+
+def params_after_steps(pp_deg, n=2):
+    t = PipelinedLMTrainer(
+        mesh=grid_mesh((8 // pp_deg, pp_deg), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, **KW)
+    for _ in range(n):
+        t.step(toks)
+    return jax.device_get(t.params)
+
+ref = params_after_steps(1)
+for pp_deg in (2, 4):
+    got = params_after_steps(pp_deg)
+    for name in ("embed", "pos"):
+        np.testing.assert_allclose(got[name], ref[name], atol=2e-6,
+                                   err_msg=f"pp={pp_deg} {name}")
+    np.testing.assert_allclose(got["layers"]["wq"], ref["layers"]["wq"],
+                               atol=2e-6, err_msg=f"pp={pp_deg} wq")
+print("SGD_PARITY_OK")
+"""
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    env.pop("JAX_PLATFORMS", None)
+    res = subprocess.run([sys.executable, "-c", body], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0 and "SGD_PARITY_OK" in res.stdout, (
+        res.stdout, res.stderr[-2000:])
 
 
 def test_pure_pp_and_microbatch_counts():
@@ -201,6 +229,67 @@ def test_restore_refuses_foreign_layout(tmp_path):
         n_microbatches=2, **_KW)
     with pytest.raises(ValueError, match="parameter leaves"):
         t_p.restore_checkpoint(str(tmp_path))
+
+
+def test_bf16_mixed_precision_trains_close_to_f32():
+    """compute_dtype='bfloat16': master weights and Adam state stay f32,
+    matmuls/activations run bf16, loss/softmax/LN accumulate f32. The
+    bf16 loss trajectory must track the f32 one closely (bf16 rounding
+    band, not a different optimization), and the master params must stay
+    f32."""
+    toks = _toks(b=16)
+    f32 = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, **_KW)
+    bf16 = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, compute_dtype="bfloat16", **_KW)
+    import jax.numpy as jnp
+    assert bf16.params["embed"].dtype == jnp.float32
+    l_f = [f32.step(toks) for _ in range(4)]
+    l_b = [bf16.step(toks) for _ in range(4)]
+    assert l_b == pytest.approx(l_f, abs=3e-2)
+    assert l_b[-1] < l_b[0]
+    with pytest.raises(ValueError, match="compute_dtype"):
+        PipelinedLMTrainer(mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+                           compute_dtype="float16", **_KW)
+
+
+def test_remat_is_loss_invariant():
+    """remat=True recomputes block activations in the backward — the SAME
+    ops in the same order, so the Adam trajectory must match the
+    non-remat trainer to reduction noise."""
+    toks = _toks(b=16)
+    base = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, **_KW)
+    rm = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, remat=True, **_KW)
+    want = [base.step(toks) for _ in range(3)]
+    got = [rm.step(toks) for _ in range(3)]
+    assert got == pytest.approx(want, abs=1e-4)
+
+
+def test_bf16_remat_flash_composition():
+    """The bench configuration's feature stack — bf16 + remat + flash —
+    composed with a real pipe degree, against the plain f32 dense
+    trainer."""
+    kw = dict(vocab_size=64, d_model=32, n_heads=2, n_layers=4, d_ff=64,
+              max_len=64, lr=1e-3, seed=0)
+    toks = np.random.default_rng(0).integers(
+        0, 64, size=(8, 48)).astype(np.int32)
+    ref = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=2, **kw)
+    full = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=2, attention="flash", compute_dtype="bfloat16",
+        remat=True, **kw)
+    want = [ref.step(toks) for _ in range(3)]
+    got = [full.step(toks) for _ in range(3)]
+    assert got == pytest.approx(want, abs=5e-2)
+    assert got[-1] < got[0]
 
 
 def test_4d_dp_pp_tp_cp_parity():
