@@ -34,55 +34,53 @@ import numpy as np
 from . import trainer
 
 
-def _ancestors(node: int):
-    """Heap ancestry root->parent (static)."""
-    chain = []
-    while node > 0:
-        node = (node - 1) // 2
-        chain.append(node)
-    return chain[::-1]
-
-
 def _extend_masked(pw, plen, z, o, active, max_len: int):
-    """Masked Algorithm-2 EXTEND of one element: pw (..., max_len+1),
-    plen traced scalar count of already-extended elements, z traced
-    scalar-per-leaf, o (..., n) per-row, active traced bool."""
+    """Masked Algorithm-2 EXTEND of one element. pw is a python LIST of
+    max_len+1 per-slot arrays (SSA registers): the original single
+    (..., max_len+1) array form updated slots with `.at[].set`, which XLA
+    materializes as full-array copies — at depth 8 that was ~80 MB per
+    slot write under the leaf vmap, and the copy traffic (not compute)
+    capped the kernel at ~325 rows/s. The list form turns every slot
+    update into one fused elementwise op over (n,). plen: traced scalar
+    count of already-extended elements; z traced scalar-per-leaf; o per
+    row; active traced bool."""
     import jax.numpy as jnp
-    pos = jnp.arange(max_len + 1)
-    # write slot `plen`: 1 when the path was empty, else 0
-    new_pw = jnp.where(pos == plen,
-                       jnp.where(plen == 0, 1.0, 0.0), pw)
+    # write slot `plen`: 1 when the path was empty, else 0 (slot index is
+    # STATIC per list position, so the condition is a cheap scalar select)
+    new_pw = [jnp.where((s == plen) & active,
+                        jnp.where(plen == 0, 1.0, 0.0), pw[s])
+              for s in range(max_len + 1)]
     # descending masked update: i from max_len-1 down to 0, live when i<plen
     for i in range(max_len - 1, -1, -1):
-        live = i < plen
-        upd_next = o * new_pw[..., i] * (i + 1) / (plen + 1)
-        nxt = jnp.where(live, new_pw[..., i + 1] + upd_next,
-                        new_pw[..., i + 1])
-        cur = jnp.where(live, new_pw[..., i] * z * (plen - i) / (plen + 1),
-                        new_pw[..., i])
-        new_pw = new_pw.at[..., i + 1].set(nxt).at[..., i].set(cur)
-    return jnp.where(active, new_pw, pw)
+        live = (i < plen) & active
+        upd_next = o * new_pw[i] * (i + 1) / (plen + 1)
+        new_pw[i + 1] = jnp.where(live, new_pw[i + 1] + upd_next,
+                                  new_pw[i + 1])
+        new_pw[i] = jnp.where(live, new_pw[i] * z * (plen - i) / (plen + 1),
+                              new_pw[i])
+    return new_pw
 
 
 def _unwound_sum(pw, plen_last, z, o, max_len: int):
     """Masked UNWOUND_PATH_SUM: total pweight with the (z, o) element
-    removed. plen_last = index of the last extended slot (traced)."""
+    removed. pw is the per-slot LIST (see _extend_masked); plen_last =
+    index of the last extended slot (traced)."""
     import jax.numpy as jnp
     nonzero = o != 0
     safe_one = jnp.where(nonzero, o, 1.0)
     zero_ok = z != 0
     safe_zero = jnp.where(zero_ok, z, 1.0)
-    # nxt starts at pw[plen_last] (traced index -> masked select)
-    pos = jnp.arange(max_len + 1)
-    sel = (pos == plen_last)
-    nxt = (pw * sel).sum(-1)
+    # nxt starts at pw[plen_last] (traced index -> scalar-select chain)
+    nxt = pw[0] * 0.0
+    for s in range(max_len + 1):
+        nxt = jnp.where(plen_last == s, pw[s], nxt)
     total = jnp.zeros_like(nxt)
     for i in range(max_len - 1, -1, -1):
         live = i < plen_last
         tmp_a = nxt * (plen_last + 1) / ((i + 1) * safe_one)
-        nxt_a = pw[..., i] - tmp_a * z * (plen_last - i) / (plen_last + 1)
+        nxt_a = pw[i] - tmp_a * z * (plen_last - i) / (plen_last + 1)
         tmp_b = jnp.where(zero_ok,
-                          (pw[..., i] / safe_zero)
+                          (pw[i] / safe_zero)
                           / ((plen_last - i) / (plen_last + 1)),
                           0.0)
         total = jnp.where(live, total + jnp.where(nonzero, tmp_a, tmp_b),
@@ -91,72 +89,96 @@ def _unwound_sum(pw, plen_last, z, o, max_len: int):
     return total
 
 
-def _level_phi(k: int, leaves: np.ndarray, sf, lv, cover, go_left,
-               n_features: int, max_depth: int):
-    """phi contributions of every depth-k leaf candidate, one vmapped batch.
+def _slot_phi(slots, sf, lv, cover, go_left, n_features: int,
+              max_depth: int):
+    """phi contributions of the trees' REAL leaves, one vmapped batch over
+    `slots` (S,) traced heap positions — real leaves first, padding after.
+
+    Round-3 shape enumerated every heap position level by level: at
+    depth 8 that is 511 candidates per tree even when num_leaves caps the
+    real count at 31 — 16x dead work, and the level loop compiled 9
+    separate program bodies. Here each slot walks its OWN path leaf ->
+    root in one fixed max_depth loop; EXTEND is symmetric in its elements
+    (the same property the duplicate-merge already exploits), so path
+    order is irrelevant and one body serves every depth, with padding
+    handled by the per-element `active` flags the machinery already has.
     go_left: (max_nodes, n) routing bits. Returns (F+1, n) additions."""
     import jax
     import jax.numpy as jnp
 
     n = go_left.shape[1]
-    if k == 0:
-        # root-as-leaf: phi gets no per-feature terms (bias handled outside)
-        return jnp.zeros((n_features + 1, n), jnp.float32)
-    anc = np.asarray([_ancestors(int(l)) for l in leaves])       # (L, k)
-    # the on-path child of each ancestor (static): next ancestor or leaf
-    nxt = np.concatenate([anc[:, 1:], leaves[:, None]], axis=1)  # (L, k)
-    is_left = (nxt == 2 * anc + 1)                               # (L, k)
-    max_len = k + 1   # root element + k (possibly merged) splits
+    S = slots.shape[0]
+    K = max_depth            # path elements per slot (padded)
+    max_len = K + 1
 
-    feats = sf[anc]                                              # (L, k)
-    covA = jnp.maximum(cover[anc], 1e-12)
-    z0 = cover[nxt] / covA                                       # (L, k)
-    hot = jnp.where(jnp.asarray(is_left)[..., None], go_left[anc],
-                    ~go_left[anc])                               # (L, k, n)
+    # walk leaf -> root: element j is the edge (parent_j -> cur_j)
+    curs, pars = [], []
+    cur = slots
+    for _ in range(K):
+        par = jnp.where(cur > 0, (cur - 1) // 2, 0)
+        curs.append(cur)
+        pars.append(par)
+        cur = par
+    cur_a = jnp.stack(curs, axis=1)                  # (S, K)
+    par_a = jnp.stack(pars, axis=1)                  # (S, K)
+    elem_active = cur_a > 0                          # padding: above root
+    is_left = cur_a == 2 * par_a + 1                 # (S, K)
+
+    feats = jnp.where(elem_active, sf[par_a], -1)    # (S, K)
+    covA = jnp.maximum(cover[par_a], 1e-12)
+    z0 = cover[cur_a] / covA                         # (S, K)
+    hot = jnp.where(is_left[..., None], go_left[par_a],
+                    ~go_left[par_a])                 # (S, K, n)
     o0 = hot.astype(jnp.float32)
-    # reachable-leaf gate: node marked leaf, every ancestor a real split
-    valid = (sf[leaves] < 0) & jnp.all(feats >= 0, axis=1)       # (L,)
+    # real reachable leaf: marked leaf, nonzero cover, and every ancestor
+    # edge it claims is a real split
+    valid = (sf[slots] < 0) & (cover[slots] > 0) & \
+        jnp.all(jnp.where(elem_active, feats >= 0, True), axis=1)
 
-    def per_leaf(feats_l, z_l, o_l, valid_l, lv_l):
+    def per_leaf(feats_l, z_l, o_l, act_l, valid_l, lv_l):
         # ---- merge duplicate features (multiply fractions, drop earlier)
-        z = [z_l[s] for s in range(k)]
-        o = [o_l[s] for s in range(k)]
-        active = [jnp.asarray(True)] * k
-        for s in range(k):
+        z = [z_l[s] for s in range(K)]
+        o = [o_l[s] for s in range(K)]
+        active = [act_l[s] for s in range(K)]
+        for s in range(K):
             for j in range(s):
-                dup = active[j] & (feats_l[j] == feats_l[s])
+                dup = active[j] & active[s] & (feats_l[j] == feats_l[s])
                 z[s] = jnp.where(dup, z[s] * z[j], z[s])
                 o[s] = jnp.where(dup, o[s] * o[j], o[s])
                 active[j] = active[j] & ~dup
         # ---- masked EXTEND: root element then each active slot
-        pw = jnp.zeros((o_l.shape[-1], max_len + 1), jnp.float32)
+        pw = [jnp.zeros(o_l.shape[-1], jnp.float32)
+              for _ in range(max_len + 1)]
         plen = jnp.asarray(0, jnp.int32)
         pw = _extend_masked(pw, plen, jnp.asarray(1.0),
                             jnp.ones(o_l.shape[-1]), jnp.asarray(True),
                             max_len)
         plen = plen + 1
-        for s in range(k):
+        for s in range(K):
             pw = _extend_masked(pw, plen, z[s], o[s], active[s], max_len)
             plen = plen + active[s].astype(jnp.int32)
         plen_last = plen - 1
         # ---- per-element unwound sums -> contributions
         contribs = []
-        for s in range(k):
+        for s in range(K):
             w = _unwound_sum(pw, plen_last, z[s], o[s], max_len)
             c = jnp.where(active[s] & valid_l,
                           w * (o[s] - z[s]) * lv_l, 0.0)
             contribs.append(c)
-        return jnp.stack(contribs)        # (k, n)
+        return jnp.stack(contribs)        # (K, n)
 
-    contrib = jax.vmap(per_leaf)(feats, z0, o0, valid, lv[leaves])  # (L,k,n)
-    seg = jnp.clip(feats, 0, n_features).reshape(-1)                # (L*k,)
+    contrib = jax.vmap(per_leaf)(feats, z0, o0, elem_active, valid,
+                                 lv[slots])                     # (S, K, n)
+    seg = jnp.clip(feats, 0, n_features).reshape(-1)            # (S*K,)
     flat = contrib.reshape(-1, n)
     return jax.ops.segment_sum(flat, seg, num_segments=n_features + 1)
 
 
-@functools.partial(jax.jit, static_argnames=("n_features", "max_depth"))
+@functools.partial(jax.jit, static_argnames=("n_features", "max_depth",
+                                             "max_leaves"))
 def _shap_one_chunk(x, sf_stack, thr_stack, lv_stack, cover_stack,
-                    ic_stack, cw_stack, n_features: int, max_depth: int):
+                    ic_stack, cw_stack, n_features: int, max_depth: int,
+                    max_leaves: int):
     """Exact TreeSHAP for one row chunk over ALL trees (lax.scan)."""
     import jax
     import jax.numpy as jnp
@@ -164,8 +186,6 @@ def _shap_one_chunk(x, sf_stack, thr_stack, lv_stack, cover_stack,
     x_t = x.T                                          # (F, n)
     n = x.shape[0]
     max_nodes = 2 ** (max_depth + 1) - 1
-    level_leaves = [np.arange(2 ** k - 1, 2 ** (k + 1) - 1)
-                    for k in range(max_depth + 1)]
 
     def one_tree(phi, tree):
         sf, thr, lv, cover, ic, cw = tree
@@ -173,17 +193,20 @@ def _shap_one_chunk(x, sf_stack, thr_stack, lv_stack, cover_stack,
             x_t[jnp.clip(sf, 0, n_features - 1)], thr,
             is_cat=ic, words=cw)                        # go-RIGHT
         go_left = ~bits                                 # (max_nodes, n)
-        add = jnp.zeros((n_features + 1, n), jnp.float32)
-        for k in range(max_depth + 1):
-            add = add + _level_phi(k, level_leaves[k], sf, lv, cover,
-                                   go_left, n_features, max_depth)
+        # this tree's REAL leaves, sorted first; padding slots resolve to
+        # non-leaf positions and are killed by _slot_phi's `valid`
+        leaf_mask = (sf < 0) & (cover > 0)
+        order = jnp.argsort(~leaf_mask, stable=True)
+        slots = order[:max_leaves]
+        add = _slot_phi(slots, sf, lv, cover, go_left, n_features,
+                        max_depth)
         # bias: cover-weighted leaf expectation (matches the host's
         # _cover_weighted_expectation exactly)
         internal = (sf >= 0) & (jnp.arange(max_nodes) < 2 ** max_depth - 1)
-        leaf_mask = (~internal) & (cover > 0)
-        tot = jnp.maximum((cover * leaf_mask).sum(), 1e-12)
-        bias = (lv * cover * leaf_mask).sum() / tot
-        add = add.at[-1].add(jnp.where((cover * leaf_mask).sum() > 0,
+        bias_mask = (~internal) & (cover > 0)
+        tot = jnp.maximum((cover * bias_mask).sum(), 1e-12)
+        bias = (lv * cover * bias_mask).sum() / tot
+        add = add.at[-1].add(jnp.where((cover * bias_mask).sum() > 0,
                                        bias, 0.0))
         return phi + add, None
 
@@ -214,11 +237,15 @@ def shap_contributions_device(x, sf, thr, lv, cover, n_features: int,
         # pad to a chunk multiple so every chunk hits the same compile
         pad = (-n) % row_chunk
         x = np.pad(x, ((0, pad), (0, 0)))
+    # widest real leaf count across trees bounds the slot batch — a
+    # 31-leaf depth-8 ensemble runs 31 slots, not 511 heap candidates
+    max_leaves = max(1, int((((np.asarray(sf) < 0)
+                              & (np.asarray(cover) > 0)).sum(axis=1)).max()))
     args = (jnp.asarray(sf), jnp.asarray(thr), jnp.asarray(lv),
             jnp.asarray(cover), jnp.asarray(ic), jnp.asarray(cw))
     out = []
     for lo in range(0, x.shape[0], row_chunk):
         xb = jnp.asarray(x[lo:lo + row_chunk])
         out.append(np.asarray(_shap_one_chunk(xb, *args, n_features,
-                                              max_depth)))
+                                              max_depth, max_leaves)))
     return np.concatenate(out, axis=0)[:n].astype(np.float64)
