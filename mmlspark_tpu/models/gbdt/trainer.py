@@ -258,11 +258,16 @@ def _voting_feature_mask(hg, hh, hc, feature_mask, cfg: TreeConfig,
     rank = jnp.argsort(order, axis=-1)
     votes = (rank < k) & jnp.isfinite(per_feat) & (per_feat > -jnp.inf)
     tally = jax.lax.psum(votes.astype(jnp.float32), axis_name)  # (m, F)
-    # global selection: top 2k by vote count (ties broken by feature id)
+    # global selection: top 2k by vote count (ties broken by feature id).
+    # Returns the winners as INDICES (m, 2k) + their got-a-vote mask so
+    # the caller can all-reduce only the voted features' histograms —
+    # the point of PV-tree is WIRE volume, and a (m, F, B) psum of a
+    # zero-masked tensor still moves all F features' bytes.
     k2 = min(2 * k, F)
     g_order = jnp.argsort(-tally, axis=-1)
-    g_rank = jnp.argsort(g_order, axis=-1)
-    return (g_rank < k2) & (tally > 0)
+    vidx = g_order[:, :k2]                                   # (m, 2k)
+    has_vote = jnp.take_along_axis(tally, vidx, axis=1) > 0  # (m, 2k)
+    return vidx, has_vote
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "axis_name", "voting_top_k"))
@@ -325,10 +330,19 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 parent_g = psum(hg[:, 0].sum(-1))
                 parent_h = psum(hh[:, 0].sum(-1))
                 parent_c = psum(hc[:, 0].sum(-1))
-                voted = _voting_feature_mask(hg, hh, hc, feature_mask, cfg,
-                                             voting_top_k, axis_name)
-                keep = voted[:, :, None]
-                hg, hh, hc = psum(hg * keep), psum(hh * keep), psum(hc * keep)
+                vidx, has_vote = _voting_feature_mask(
+                    hg, hh, hc, feature_mask, cfg, voting_top_k, axis_name)
+                # PV-tree's payoff: only the 2k voted features' histograms
+                # cross the wire — gather (m, 2k, B), psum the compacted
+                # slab, scatter back to full width (non-voted stay zero,
+                # so the split search never picks them)
+                gather = lambda a: jnp.take_along_axis(
+                    a, vidx[:, :, None], axis=1) * has_vote[:, :, None]
+                rows = jnp.arange(vidx.shape[0])[:, None]
+                scatter = lambda z, v: jnp.zeros_like(z).at[rows, vidx].set(v)
+                hg = scatter(hg, psum(gather(hg)))
+                hh = scatter(hh, psum(gather(hh)))
+                hc = scatter(hc, psum(gather(hc)))
             else:
                 hg, hh, hc = psum(hg), psum(hh), psum(hc)
                 parent_g, parent_h, parent_c = (hg[:, 0].sum(-1),
