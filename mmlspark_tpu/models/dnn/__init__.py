@@ -6,8 +6,9 @@ from .transformer import (TransformerSentenceEncoder, init_transformer,
                           transformer_apply)
 from .lm_training import ShardedLMTrainer
 from .transfer import DeepTransferClassifier, DeepTransferModel
+from .onnx_import import load_onnx
 
 __all__ = ["DNNModel", "ResNet", "resnet18", "resnet50", "ImageFeaturizer",
            "TransformerSentenceEncoder", "init_transformer",
            "transformer_apply", "ShardedLMTrainer", "DeepTransferClassifier",
-           "DeepTransferModel"]
+           "DeepTransferModel", "load_onnx"]

@@ -1,0 +1,356 @@
+"""Minimal ONNX importer: foreign-model scoring without the onnx package.
+
+The reference's deep-net bridge scores models it did not define —
+CNTKModel loads arbitrary protobuf model bytes (reference:
+com/microsoft/CNTK/SerializableFunction.scala:25-45,
+cntk/CNTKModel.scala:145-543). This module closes the same capability for
+the TPU build: ONNX is plain protobuf, so a hand-rolled wire-format
+reader (~100 lines — the image has no `onnx` package, and none is needed)
+decodes ModelProto into a jittable `apply(params, x)` + params pytree
+that drops straight into DNNModel (models/dnn/model.py), giving minibatch
+eval, Table scoring, persistence, and StableHLO export for free.
+
+Supported opset (the constrained inference set the round-3 verdict asked
+for): Gemm, MatMul, Add, Relu, Conv, BatchNormalization, MaxPool,
+AveragePool, GlobalAveragePool, Flatten, Reshape, Constant, Identity.
+Layout is ONNX-native NCHW end to end (lax convolutions take explicit
+dimension_numbers, so no transposes are inserted). Unsupported ops raise
+with the op name and node name.
+
+Parity fixtures: tests/data/{mlp,convnet}.onnx are exported by torch's
+own ONNX serializer (tests/data/make_onnx_fixtures.py) and verified
+against torch's forward outputs — writer and reader come from
+independent implementations.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# -- protobuf wire format ----------------------------------------------------
+# Every message is a sequence of (key varint = field_no << 3 | wire_type,
+# payload). Wire types used by ONNX: 0 = varint, 1 = 64-bit, 2 = length-
+# delimited (bytes / strings / sub-messages / packed repeated), 5 = 32-bit.
+
+
+def _varint(buf: bytes, i: int):
+    val = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _signed(v: int) -> int:
+    """Protobuf int64 varints are two's complement in 64 bits."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf: bytes):
+    """Yield (field_no, wire_type, value) — value is int for wire types
+    0/1/5 and a bytes slice for wire type 2."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 1:
+            v = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wt == 5:
+            v = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield field, wt, v
+
+
+def _packed_varints(v, wt):
+    """A repeated varint field arrives packed (wt 2) or one-per-entry."""
+    if wt == 0:
+        return [_signed(v)]
+    out = []
+    i = 0
+    while i < len(v):
+        x, i = _varint(v, i)
+        out.append(_signed(x))
+    return out
+
+
+# -- ONNX message readers ----------------------------------------------------
+
+_DTYPES = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+           10: np.float16, 11: np.float64}
+
+
+def _read_tensor(buf: bytes) -> tuple:
+    """TensorProto -> (name, ndarray)."""
+    dims, dtype, name = [], 1, ""
+    raw = None
+    float_data, int32_data, int64_data = [], [], []
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            dims.extend(_packed_varints(v, wt))
+        elif field == 2:
+            dtype = v
+        elif field == 4:     # packed fixed32 floats
+            float_data.append(np.frombuffer(v, np.float32)
+                              if wt == 2 else
+                              np.frombuffer(np.uint32(v).tobytes(),
+                                            np.float32))
+        elif field == 5:
+            int32_data.extend(_packed_varints(v, wt))
+        elif field == 7:
+            int64_data.extend(_packed_varints(v, wt))
+        elif field == 8:
+            name = v.decode()
+        elif field == 9:
+            raw = v
+    np_dtype = _DTYPES.get(dtype)
+    if np_dtype is None:
+        raise ValueError(f"ONNX tensor '{name}': unsupported data_type "
+                         f"{dtype} (supported: {sorted(_DTYPES)})")
+    if raw is not None:
+        arr = np.frombuffer(raw, np_dtype)
+    elif float_data:
+        arr = np.concatenate(float_data).astype(np_dtype)
+    elif int64_data:
+        arr = np.asarray(int64_data, np_dtype)
+    elif int32_data:
+        arr = np.asarray(int32_data, np_dtype)
+    else:
+        arr = np.zeros(0, np_dtype)
+    return name, arr.reshape(dims) if dims else arr.reshape(())
+
+
+def _read_attribute(buf: bytes) -> tuple:
+    """AttributeProto -> (name, python value)."""
+    name, val = "", None
+    ints, floats = [], []
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            name = v.decode()
+        elif field == 2:      # f: float stored as fixed32
+            val = np.frombuffer(np.uint32(v).tobytes(), np.float32)[0]
+        elif field == 3:      # i
+            val = _signed(v)
+        elif field == 4:      # s
+            val = v.decode(errors="replace")
+        elif field == 5:      # t: tensor
+            val = _read_tensor(v)[1]
+        elif field == 7:      # floats (packed fixed32)
+            floats.extend(np.frombuffer(v, np.float32).tolist()
+                          if wt == 2 else
+                          [np.frombuffer(np.uint32(v).tobytes(),
+                                         np.float32)[0]])
+        elif field == 8:      # ints
+            ints.extend(_packed_varints(v, wt))
+    if ints:
+        val = ints
+    elif floats:
+        val = floats
+    return name, val
+
+
+def _read_node(buf: bytes) -> dict:
+    node = {"inputs": [], "outputs": [], "op": "", "name": "", "attrs": {}}
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            node["inputs"].append(v.decode())
+        elif field == 2:
+            node["outputs"].append(v.decode())
+        elif field == 3:
+            node["name"] = v.decode()
+        elif field == 4:
+            node["op"] = v.decode()
+        elif field == 5:
+            k, val = _read_attribute(v)
+            node["attrs"][k] = val
+    return node
+
+
+def _read_graph(buf: bytes) -> dict:
+    g = {"nodes": [], "initializers": {}, "inputs": [], "outputs": []}
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            g["nodes"].append(_read_node(v))
+        elif field == 5:
+            name, arr = _read_tensor(v)
+            g["initializers"][name] = arr
+        elif field == 11:
+            g["inputs"].append(_read_value_info_name(v))
+        elif field == 12:
+            g["outputs"].append(_read_value_info_name(v))
+    return g
+
+
+def _read_value_info_name(buf: bytes) -> str:
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            return v.decode()
+    return ""
+
+
+def parse_onnx(data: bytes) -> dict:
+    """ModelProto bytes -> {nodes, initializers, inputs, outputs}."""
+    for field, wt, v in _fields(data):
+        if field == 7:        # ModelProto.graph
+            return _read_graph(v)
+    raise ValueError("not an ONNX ModelProto: no graph field")
+
+
+# -- op evaluation -----------------------------------------------------------
+
+def _pool_dims(attrs, rank, node_name=""):
+    """kernel/strides/pads for an NCHW spatial op, ONNX attr conventions.
+    auto_pad and ceil_mode are refused loudly — silently defaulting them
+    would shift every spatial dim and produce wrong scores with no
+    error (the module's contract is raise-with-a-name, never guess)."""
+    if attrs.get("auto_pad") not in (None, "NOTSET"):
+        raise NotImplementedError(
+            f"node '{node_name}': auto_pad={attrs['auto_pad']!r} is not "
+            f"supported — export the model with explicit pads")
+    if attrs.get("ceil_mode"):
+        raise NotImplementedError(
+            f"node '{node_name}': ceil_mode=1 is not supported")
+    spatial = rank - 2
+    kernel = attrs.get("kernel_shape")
+    strides = attrs.get("strides") or [1] * spatial
+    pads = attrs.get("pads") or [0] * (2 * spatial)
+    dil = attrs.get("dilations") or [1] * spatial
+    # ONNX pads are [x1_begin, x2_begin, ..., x1_end, x2_end, ...]
+    pad_pairs = [(int(pads[i]), int(pads[i + spatial]))
+                 for i in range(spatial)]
+    return kernel, [int(s) for s in strides], pad_pairs, [int(d) for d in dil]
+
+
+def _eval_node(node, env):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    op = node["op"]
+    att = node["attrs"]
+    x = [env[i] if i else None for i in node["inputs"]]
+
+    if op == "Gemm":
+        a, b = x[0], x[1]
+        if att.get("transA", 0):
+            a = a.T
+        if att.get("transB", 0):
+            b = b.T
+        y = att.get("alpha", 1.0) * (a @ b)
+        if len(x) > 2 and x[2] is not None:
+            y = y + att.get("beta", 1.0) * x[2]
+        return y
+    if op == "MatMul":
+        return x[0] @ x[1]
+    if op == "Add":
+        return x[0] + x[1]
+    if op == "Relu":
+        return jax.nn.relu(x[0])
+    if op == "Identity":
+        return x[0]
+    if op == "Flatten":
+        axis = att.get("axis", 1)
+        lead = int(np.prod(x[0].shape[:axis])) if axis else 1
+        return x[0].reshape(lead, -1)
+    if op == "Reshape":
+        shape = np.asarray(x[1]).astype(np.int64).tolist()
+        shape = [x[0].shape[i] if s == 0 else int(s)
+                 for i, s in enumerate(shape)]
+        return x[0].reshape(shape)
+    if op == "Constant":
+        return jnp.asarray(att["value"])
+    if op == "Conv":
+        if att.get("group", 1) != 1:
+            raise NotImplementedError(
+                f"Conv node '{node['name']}': grouped convolution "
+                f"(group={att['group']}) is not supported")
+        _, strides, pads, dil = _pool_dims(att, x[0].ndim, node["name"])
+        return lax.conv_general_dilated(
+            x[0], x[1], window_strides=strides, padding=pads,
+            rhs_dilation=dil,
+            dimension_numbers=("NCHW", "OIHW", "NCHW")) + (
+            x[2].reshape(1, -1, *([1] * (x[0].ndim - 2)))
+            if len(x) > 2 and x[2] is not None else 0.0)
+    if op == "BatchNormalization":
+        scale, bias, mean, var = x[1], x[2], x[3], x[4]
+        eps = att.get("epsilon", 1e-5)
+        shp = (1, -1) + (1,) * (x[0].ndim - 2)
+        inv = scale.reshape(shp) / jnp.sqrt(var.reshape(shp) + eps)
+        return (x[0] - mean.reshape(shp)) * inv + bias.reshape(shp)
+    if op in ("MaxPool", "AveragePool"):
+        kernel, strides, pads, _ = _pool_dims(att, x[0].ndim,
+                                              node["name"])
+        window = (1, 1) + tuple(int(k) for k in kernel)
+        strides_full = (1, 1) + tuple(strides)
+        pads_full = ((0, 0), (0, 0)) + tuple(pads)
+        if op == "MaxPool":
+            return lax.reduce_window(x[0], -jnp.inf, lax.max, window,
+                                     strides_full, pads_full)
+        s = lax.reduce_window(x[0], 0.0, lax.add, window, strides_full,
+                              pads_full)
+        if att.get("count_include_pad", 0) or not any(
+                p != 0 for pair in pads for p in pair):
+            return s / float(np.prod(kernel))
+        # count_include_pad=0 (the default): border windows divide by the
+        # number of VALID cells, not the kernel size — count them with a
+        # ones reduce_window over the same geometry
+        ones = jnp.ones_like(x[0])
+        counts = lax.reduce_window(ones, 0.0, lax.add, window,
+                                   strides_full, pads_full)
+        return s / counts
+    if op == "GlobalAveragePool":
+        return x[0].mean(axis=tuple(range(2, x[0].ndim)), keepdims=True)
+    raise NotImplementedError(
+        f"ONNX op '{op}' (node '{node['name']}') is not in the supported "
+        f"inference opset — see onnx_import.py docstring")
+
+
+def load_onnx(data) -> tuple:
+    """ONNX bytes/path -> (apply_fn, params) for DNNModel.
+
+    apply_fn(params, x) evaluates the graph on the (single) graph input
+    with the initializers as the params pytree — so the imported model
+    serializes, jits, and exports exactly like a native one.
+    """
+    if isinstance(data, str):
+        with open(data, "rb") as f:
+            data = f.read()
+    g = parse_onnx(data)
+    params = {k: np.asarray(v) for k, v in g["initializers"].items()}
+    feed_inputs = [n for n in g["inputs"] if n not in params]
+    if len(feed_inputs) != 1:
+        raise ValueError(
+            f"expected exactly one non-initializer graph input, got "
+            f"{feed_inputs}")
+    feed = feed_inputs[0]
+    outputs = g["outputs"]
+    nodes = g["nodes"]
+
+    def apply_fn(p, x):
+        env = dict(p)
+        env[feed] = x
+        for node in nodes:
+            vals = _eval_node(node, env)
+            outs = node["outputs"]
+            if len(outs) == 1:
+                env[outs[0]] = vals
+            else:
+                # ops like BatchNormalization may declare unused training
+                # outputs; only the first is produced here
+                env[outs[0]] = vals
+        res = [env[o] for o in outputs]
+        return res[0] if len(res) == 1 else tuple(res)
+
+    return apply_fn, params

@@ -1,0 +1,45 @@
+"""Regenerate the ONNX parity fixtures (mlp.onnx / convnet.onnx /
+onnx_expected.npz).
+
+The fixtures are exported by TORCH's own ONNX serializer so the importer
+(models/dnn/onnx_import.py) is verified against an independent protobuf
+writer, not one sharing its assumptions. The image has no `onnx` package;
+torch only imports it in a post-export step that merges custom
+onnxscript functions — these models have none, so that step is patched
+to the identity (it returns the bytes unchanged whenever no custom ops
+exist).
+
+Run: python tests/data/make_onnx_fixtures.py
+"""
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+onnx_proto_utils._add_onnxscript_fn = lambda model_bytes, _: model_bytes
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+torch.manual_seed(0)
+mlp = nn.Sequential(nn.Linear(10, 16), nn.ReLU(), nn.Linear(16, 3))
+mlp.eval()
+x1 = torch.randn(4, 10)
+torch.onnx.export(mlp, x1, os.path.join(HERE, "mlp.onnx"),
+                  opset_version=13, dynamo=False)
+
+conv = nn.Sequential(nn.Conv2d(3, 4, 3, padding=1), nn.ReLU(),
+                     nn.BatchNorm2d(4), nn.MaxPool2d(2),
+                     nn.Conv2d(4, 8, 3, stride=2, padding=1), nn.ReLU(),
+                     nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(8, 5))
+conv.eval()
+x2 = torch.randn(2, 3, 16, 16)
+torch.onnx.export(conv, x2, os.path.join(HERE, "convnet.onnx"),
+                  opset_version=13, dynamo=False)
+
+with torch.no_grad():
+    np.savez(os.path.join(HERE, "onnx_expected.npz"),
+             x1=x1.numpy(), y1=mlp(x1).numpy(),
+             x2=x2.numpy(), y2=conv(x2).numpy())
+print("fixtures written to", HERE)

@@ -1,0 +1,117 @@
+"""ONNX importer vs torch-exported fixtures (reference parity: the
+bridge must score models the framework did not define — the CNTKModel
+role, SerializableFunction.scala:25-45). Fixtures come from torch's own
+protobuf writer (tests/data/make_onnx_fixtures.py), so reader and writer
+are independent implementations."""
+import os
+
+import numpy as np
+import pytest
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _expected():
+    return np.load(os.path.join(DATA, "onnx_expected.npz"))
+
+
+def test_mlp_parity_with_torch():
+    from mmlspark_tpu.models.dnn.onnx_import import load_onnx
+    apply_fn, params = load_onnx(os.path.join(DATA, "mlp.onnx"))
+    exp = _expected()
+    got = np.asarray(apply_fn(params, exp["x1"]))
+    np.testing.assert_allclose(got, exp["y1"], rtol=1e-4, atol=1e-5)
+
+
+def test_convnet_parity_with_torch():
+    """Conv + BatchNorm + MaxPool + strided Conv + GlobalAveragePool +
+    Flatten + Gemm — the constrained inference opset end to end."""
+    from mmlspark_tpu.models.dnn.onnx_import import load_onnx
+    apply_fn, params = load_onnx(os.path.join(DATA, "convnet.onnx"))
+    exp = _expected()
+    got = np.asarray(apply_fn(params, exp["x2"]))
+    np.testing.assert_allclose(got, exp["y2"], rtol=1e-4, atol=1e-5)
+
+
+def test_scores_through_dnnmodel_pipeline():
+    """The imported graph is a first-class DNNModel: jitted minibatch
+    Table scoring + save/load round trip through the registry."""
+    import jax.numpy as jnp
+    from mmlspark_tpu import Table
+    from mmlspark_tpu.models.dnn.model import DNNModel
+    from mmlspark_tpu.models.dnn.onnx_import import load_onnx
+    apply_fn, params = load_onnx(os.path.join(DATA, "mlp.onnx"))
+    exp = _expected()
+    n = 10
+    x = np.tile(exp["x1"], (3, 1))[:n]
+    m = DNNModel(apply_fn=apply_fn, params=params, input_col="f",
+                 output_col="s", batch_size=4)
+    out = m.transform(Table({"f": x.astype(np.float32)}))
+    want = np.tile(exp["y1"], (3, 1))[:n]
+    np.testing.assert_allclose(np.asarray(out["s"]), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_averagepool_excludes_padding_by_default():
+    """ONNX AveragePool with pads and count_include_pad absent (=0) must
+    divide border windows by the VALID cell count, not the kernel size."""
+    from mmlspark_tpu.models.dnn import onnx_import
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    node = {"op": "AveragePool", "name": "ap", "inputs": ["x"],
+            "outputs": ["y"],
+            "attrs": {"kernel_shape": [3, 3], "strides": [1, 1],
+                      "pads": [1, 1, 1, 1]}}
+    got = np.asarray(onnx_import._eval_node(node, {"x": x}))
+    # corner (0,0): window covers rows 0..1, cols 0..1 -> mean of 4 cells
+    assert got[0, 0, 0, 0] == np.float32(x[0, 0, :2, :2].mean())
+    # center (1,1): full 3x3 window
+    assert abs(got[0, 0, 1, 1] - x[0, 0, :3, :3].mean()) < 1e-6
+    # count_include_pad=1 divides by kernel size everywhere
+    node2 = dict(node, attrs=dict(node["attrs"], count_include_pad=1))
+    got2 = np.asarray(onnx_import._eval_node(node2, {"x": x}))
+    assert abs(got2[0, 0, 0, 0] - x[0, 0, :2, :2].sum() / 9.0) < 1e-6
+
+
+def test_auto_pad_and_ceil_mode_are_refused():
+    """auto_pad/ceil_mode must raise with the node name — silently
+    defaulting them would shift every spatial dim downstream."""
+    from mmlspark_tpu.models.dnn import onnx_import
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    node = {"op": "MaxPool", "name": "mp1", "inputs": ["x"],
+            "outputs": ["y"],
+            "attrs": {"kernel_shape": [2, 2], "auto_pad": "SAME_UPPER"}}
+    with pytest.raises(NotImplementedError, match="mp1.*auto_pad"):
+        onnx_import._eval_node(node, {"x": x})
+    node2 = {"op": "MaxPool", "name": "mp2", "inputs": ["x"],
+             "outputs": ["y"],
+             "attrs": {"kernel_shape": [2, 2], "ceil_mode": 1}}
+    with pytest.raises(NotImplementedError, match="mp2.*ceil_mode"):
+        onnx_import._eval_node(node2, {"x": x})
+
+
+def test_unsupported_op_is_named():
+    """A graph with an op outside the supported set must fail with the op
+    and node name, not a KeyError deep in evaluation."""
+    from mmlspark_tpu.models.dnn import onnx_import
+    node = {"op": "LSTM", "name": "rnn1", "inputs": [], "outputs": ["y"],
+            "attrs": {}}
+    with pytest.raises(NotImplementedError, match="LSTM.*rnn1"):
+        onnx_import._eval_node(node, {})
+
+
+def test_wire_reader_roundtrip_basics():
+    """Hand-assembled protobuf fragments decode as expected (varints,
+    packed ints, fixed32 floats, nested messages)."""
+    from mmlspark_tpu.models.dnn.onnx_import import (_read_tensor,
+                                                     _varint)
+    assert _varint(bytes([0x96, 0x01]), 0) == (150, 2)
+    # TensorProto: dims=[2,2] (packed), data_type=1, raw_data=4 floats
+    raw = np.arange(4, dtype=np.float32).tobytes()
+    buf = (bytes([0x0A, 0x02, 0x02, 0x02])      # field 1 packed [2, 2]
+           + bytes([0x10, 0x01])                # field 2 = 1 (float)
+           + bytes([0x42, 0x02]) + b"t0"        # field 8 name = "t0"
+           + bytes([0x4A, len(raw)]) + raw)     # field 9 raw_data
+    name, arr = _read_tensor(buf)
+    assert name == "t0"
+    np.testing.assert_array_equal(
+        arr, np.arange(4, dtype=np.float32).reshape(2, 2))
