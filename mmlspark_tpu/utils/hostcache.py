@@ -1,0 +1,32 @@
+"""Host-fingerprinted XLA compile-cache directory.
+
+Persistent-cache entries embed the compiling host's vector ISA; loading
+an entry compiled for a different host aborts or deadlocks XLA:CPU
+(observed when the dev VM generation changed between rounds). Both the
+test session (tests/conftest.py) and bench.py namespace the cache by
+this fingerprint so foreign entries can never be loaded.
+
+Stdlib-only imports: conftest must be able to load this file BEFORE the
+jax backend initializes (it does so by path, skipping the package
+__init__, which pulls the full framework)."""
+import hashlib
+import os
+
+
+def host_cache_dir(root: str) -> str:
+    """`root`/host-<sha1 of jaxlib version + cpuinfo flags>."""
+    try:
+        import jaxlib
+        tag = jaxlib.__version__
+    except Exception:  # noqa: BLE001 - fingerprint degrades, never fails
+        tag = "nojaxlib"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    tag += line
+                    break
+    except OSError:
+        pass
+    fp = hashlib.sha1(tag.encode()).hexdigest()[:12]
+    return os.path.join(root, f"host-{fp}")

@@ -11,6 +11,17 @@ import os
 # jax_platforms before any test code runs, so flip the config (not just env)
 # back to an 8-device virtual CPU before the backend initializes.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA CPU aborts the PROCESS (LOG(FATAL) in rendezvous.cc) when the 8
+# per-device threads of a collective don't all reach the rendezvous within
+# 40 s — on a 1-core CI host, thread starvation under suite load trips
+# that constantly (observed: "Expected 8 threads to join the rendezvous,
+# but only 6 of them arrived on time"). Starvation is not deadlock: raise
+# the termination timeout so slow scheduling finishes instead of killing
+# the run. Must be in XLA_FLAGS before the backend initializes.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -18,9 +29,22 @@ jax.config.update("jax_num_cpu_devices", 8)
 # persistent compile cache: the suite compiles thousands of XLA programs in
 # one process; re-runs load them from disk instead (also sidesteps a
 # rare LLVM crash observed when the same program recompiles late in a
-# long suite process)
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+# long suite process). The cache dir is NAMESPACED by a host-CPU
+# fingerprint (mmlspark_tpu/utils/hostcache.py — loaded by PATH so the
+# package __init__ doesn't run before the backend config above is set):
+# cached CPU executables baked for a different host's vector ISA abort
+# (SIGABRT in collective rendezvous) when loaded on this one.
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "_hostcache", os.path.join(os.path.dirname(__file__), "..",
+                               "mmlspark_tpu", "utils", "hostcache.py"))
+_hostcache = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_hostcache)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    _hostcache.host_cache_dir(
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache")))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402

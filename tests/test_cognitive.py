@@ -97,12 +97,16 @@ class _AzureMock(BaseHTTPRequestHandler):
         raw = self.rfile.read(n)
         path = urllib.parse.urlparse(self.path).path
         if "/speech/" in path:  # audio payload: not JSON
+            # Speech REST short-audio, format=simple: {RecognitionStatus,
+            # DisplayText, ...} (SpeechToText.scala SpeechResponse)
             q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
             return self._reply(200, {
                 "RecognitionStatus": "Success",
                 "DisplayText": f"heard {len(raw)} bytes",
                 "Language": q.get("language", ["?"])[0]})
         body = json.loads(raw or b"{}")
+        # Text Analytics v2.0 "Sentiment": {documents: [{id, score}],
+        # errors: [{id, message}]} (TextAnalytics.scala)
         if path.endswith("/sentiment"):
             docs, errs = [], []
             for d in body["documents"]:
@@ -113,42 +117,53 @@ class _AzureMock(BaseHTTPRequestHandler):
                     score = 0.9 if "good" in text else 0.1
                     docs.append({"id": d["id"], "score": score})
             return self._reply(200, {"documents": docs, "errors": errs})
+        # Text Analytics v2.0 "Detect Language": detectedLanguages
+        # [{name, iso6391Name, score}] (TextAnalytics.scala)
         if path.endswith("/languages"):
             docs = [{"id": d["id"], "detectedLanguages": [
                 {"name": "French" if "bonjour" in d["text"] else "English",
                  "iso6391Name": "fr" if "bonjour" in d["text"] else "en",
                  "score": 1.0}]} for d in body["documents"]]
             return self._reply(200, {"documents": docs, "errors": []})
+        # Text Analytics v2.0 "Key Phrases" (TextAnalytics.scala)
         if path.endswith("/keyPhrases"):
             docs = [{"id": d["id"],
                      "keyPhrases": [w for w in d["text"].split()
                                     if len(w) > 4]} for d in body["documents"]]
             return self._reply(200, {"documents": docs, "errors": []})
+        # Anomaly Detector v1.0 timeseries/entire/detect:
+        # ADEntireResponse (AnomalyDetection.scala)
         if path.endswith("/entire/detect"):
             vals = [p["value"] for p in body["series"]]
             mean = sum(vals) / max(len(vals), 1)
             return self._reply(200, {
                 "expectedValues": [mean] * len(vals),
                 "isAnomaly": [v > 3 * mean for v in vals]})
+        # Anomaly Detector v1.0 timeseries/last/detect: ADLastResponse
         if path.endswith("/last/detect"):
             vals = [p["value"] for p in body["series"]]
             mean = sum(vals[:-1]) / max(len(vals) - 1, 1)
             return self._reply(200, {"isAnomaly": vals[-1] > 3 * mean,
                                      "expectedValue": mean})
+        # Computer Vision v2.0 /ocr: {language, regions: [{lines:
+        # [{words: [{text}]}]}]} (ComputerVision.scala OCRResponse)
         if "/ocr" in path:
             return self._reply(200, {
                 "language": "en", "regions": [{"lines": [{"words": [
                     {"text": body.get("url", "")[-7:]}]}]}]})
+        # Face API v1.0 /verify: {isIdentical, confidence} (Face.scala)
         if path.endswith("/verify"):
             same = body.get("faceId1") == body.get("faceId2")
             return self._reply(200, {"isIdentical": same,
                                      "confidence": 0.95 if same else 0.05})
+        # Face API v1.0 /group: {groups, messyGroup} (Face.scala)
         if path.endswith("/group"):
             ids = body["faceIds"]
             groups = [[i for i in ids if i.startswith("a")],
                       [i for i in ids if not i.startswith("a")]]
             return self._reply(200, {"groups": [g for g in groups if g],
                                      "messyGroup": []})
+        # Face API v1.0 /identify: [{faceId, candidates}] (Face.scala)
         if path.endswith("/identify"):
             return self._reply(200, [
                 {"faceId": fid,
