@@ -286,10 +286,12 @@ def test_serving_concurrent_throughput():
         rps = len(lat) / wall
         print(f"serving 16-client: {rps:.0f} req/s, "
               f"p50 {p50:.2f} ms, p99 {p99:.2f} ms")
-        # CI floor: the 16 client THREADS share this host's core(s) with
-        # the server, so the floor is set well under quiet-machine rates
-        assert rps > 2000, f"{rps:.0f} req/s under concurrent load"
-        assert p99 < 100, f"p99 {p99:.1f}ms"
+        # floor: 7,454 req/s measured on a QUIET 1-core CI host (the
+        # suite runs this test serially); 3,441 with a second full suite
+        # running in parallel. The floor sits under the contended number
+        # so background load cannot flake the suite.
+        assert rps > 3000, f"{rps:.0f} req/s under concurrent load"
+        assert p99 < 50, f"p99 {p99:.1f}ms"
     finally:
         q.stop()
         server.stop()
