@@ -419,21 +419,62 @@ class PipelinedLMTrainer:
         # aliasing under the multi-device CPU backend + shard_map
         # collectives SIGABRTs the process (observed on the 8-device test
         # mesh, jax 0.9), and CPU is only the test/dryrun vehicle anyway.
-        donate = ((0, 1) if mesh.devices.flat[0].platform == "tpu"
-                  else ())
+        # (Shared with run()'s multi-step executable.)
+        self._donate = ((0, 1) if mesh.devices.flat[0].platform == "tpu"
+                        else ())
 
-        @_functools.partial(jax.jit, donate_argnums=donate)
+        @_functools.partial(jax.jit, donate_argnums=self._donate)
         def train_step(params, opt_state, tokens):
             loss, grads = mapped(params, tokens)
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
         self._step = train_step
+        self._multi = None   # lazily-built multi-step executable (run())
 
-    def step(self, tokens: np.ndarray) -> float:
-        """One dp x pp (x tp) (x cp) update; returns the batch loss."""
+    def run(self, tokens: np.ndarray, n_steps: int) -> float:
+        """n_steps chained updates with ONE host sync; returns the final
+        loss. The steps run as a device-side `lax.scan`, so a slow or
+        high-latency host never sits between consecutive updates — the
+        standard TPU training-loop shape (the per-step `step()` pays a
+        host round trip per update, which on the dev tunnel costs more
+        than the step itself). Same batch every step; interleave `run`
+        calls for fresh data."""
+        import operator
+
         import jax
         import jax.numpy as jnp
+        self._check_batch(tokens)
+        n_steps = operator.index(n_steps)   # 2.9 must raise, not run 2
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        if self._multi is None:
+            inner = self._step.__wrapped__
+
+            # n rides as a TRACED loop bound (fori_loop, not a static
+            # scan length): one executable serves every n_steps — a
+            # per-n recompile of the full 4D program would cost minutes
+            # on real shapes, dwarfing the host-sync latency run() saves
+            @_functools.partial(jax.jit, donate_argnums=self._donate)
+            def multi(params, opt_state, tok, n):
+                def body(_, c):
+                    p, o, _l = c
+                    return inner(p, o, tok)
+                return jax.lax.fori_loop(
+                    0, n, body, (params, opt_state, jnp.float32(0.0)))
+            self._multi = multi
+        self.params, self.opt_state, loss = self._multi(
+            self.params, self.opt_state, self._to_device(tokens),
+            jnp.asarray(n_steps, jnp.int32))
+        return float(loss)
+
+    def _to_device(self, tokens):
+        import jax
+        import jax.numpy as jnp
+        return jax.device_put(jnp.asarray(tokens, jnp.int32),
+                              self._batch_sharding)
+
+    def _check_batch(self, tokens) -> None:
         from ...parallel import DATA_AXIS
         dp = self.mesh.shape[DATA_AXIS]
         B = tokens.shape[0]
@@ -445,10 +486,12 @@ class PipelinedLMTrainer:
             raise ValueError(
                 f"sequence length {tokens.shape[1]} must divide by the "
                 f"seq axis ({self.cp})")
-        tok = jax.device_put(jnp.asarray(tokens, jnp.int32),
-                             self._batch_sharding)
+
+    def step(self, tokens: np.ndarray) -> float:
+        """One dp x pp (x tp) (x cp) update; returns the batch loss."""
+        self._check_batch(tokens)
         self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, tok)
+            self.params, self.opt_state, self._to_device(tokens))
         return float(loss)
 
     # -- checkpoint/resume ---------------------------------------------------

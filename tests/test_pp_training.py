@@ -109,6 +109,31 @@ def test_pure_pp_and_microbatch_counts():
         assert pp.step(toks) == pytest.approx(want, abs=1e-4), m
 
 
+def test_run_multi_step_matches_step_loop():
+    """run(tokens, n) chains n updates in ONE device-side fori_loop (one
+    host sync) and must land on the same trajectory as n step() calls
+    from identical init."""
+    toks = _toks()
+    a = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, **_KW)
+    b = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, **_KW)
+    for _ in range(3):
+        last_step = a.step(toks)
+    last_run = b.run(toks, 3)
+    assert last_run == pytest.approx(last_step, abs=1e-5)
+    import jax
+    np.testing.assert_allclose(jax.device_get(b.params["embed"]),
+                               jax.device_get(a.params["embed"]),
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="n_steps"):
+        b.run(toks, 0)
+    with pytest.raises(TypeError):
+        b.run(toks, 2.5)   # silent truncation would run 2 steps
+
+
 def test_layers_are_stage_sharded():
     """The point of PP: each device materializes only its stage's layers."""
     pp = PipelinedLMTrainer(
