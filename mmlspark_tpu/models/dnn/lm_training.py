@@ -46,6 +46,28 @@ def _param_shardings(params: dict, mesh):
     }
 
 
+def _build_multi_step(step_fn, donate):
+    """Jitted (params, opt_state, tok, n) -> (params, opt_state, last
+    loss): n optimizer steps as a device-side fori_loop with n as a
+    TRACED bound — one executable serves every chunk size (a static
+    count would recompile the full program per distinct n). Shared by
+    ShardedLMTrainer.run and PipelinedLMTrainer.run; step_fn is the
+    UN-jitted single step so donation applies once, at this boundary."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def multi(params, opt_state, tok, n):
+        def body(_, carry):
+            p, o, _l = carry
+            return step_fn(p, o, tok)
+        return jax.lax.fori_loop(0, n, body,
+                                 (params, opt_state, jnp.float32(0.0)))
+    return multi
+
+
 def _lm_loss(params, meta, tokens):
     """Mean next-token cross-entropy for a (B, S) batch (causal).
     The forward pass IS transformer_apply (causal, unit attention scale —
@@ -122,26 +144,48 @@ class ShardedLMTrainer:
         # slower on the dev chip (see pp_training.train_step for numbers
         # and for why CPU must NOT donate — multi-device CPU aliasing
         # SIGABRTs under shard_map/collective programs)
-        donate = ((0, 1) if mesh.devices.flat[0].platform == "tpu"
-                  else ())
+        self._donate = ((0, 1) if mesh.devices.flat[0].platform == "tpu"
+                        else ())
 
-        @functools.partial(jax.jit, donate_argnums=donate)
         def train_step(params, opt_state, tokens):
             loss, grads = jax.value_and_grad(
                 lambda p: _lm_loss(p, meta, tokens))(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        self._step = train_step
+        # raw step kept for run()'s fori_loop body; jitted once here
+        self._step_fn = train_step
+        self._step = jax.jit(train_step, donate_argnums=self._donate)
+        self._multi = None   # lazily-built multi-step executable (run())
+
+    def _to_device(self, tokens):
+        import jax
+        import jax.numpy as jnp
+        return jax.device_put(jnp.asarray(tokens, jnp.int32),
+                              self._batch_sharding)
 
     def step(self, tokens: np.ndarray) -> float:
         """One dp x tp update; returns the batch loss."""
-        import jax
-        import jax.numpy as jnp
-        tok = jax.device_put(jnp.asarray(tokens, jnp.int32),
-                             self._batch_sharding)
         self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, tok)
+            self.params, self.opt_state, self._to_device(tokens))
+        return float(loss)
+
+    def run(self, tokens: np.ndarray, n_steps: int) -> float:
+        """n_steps chained updates with ONE host sync; returns the final
+        loss. Same contract as PipelinedLMTrainer.run: a device-side
+        fori_loop with n as a TRACED bound (one executable for every
+        chunk size), one host round trip per chunk."""
+        import operator
+
+        import jax.numpy as jnp
+        n_steps = operator.index(n_steps)
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        if self._multi is None:
+            self._multi = _build_multi_step(self._step_fn, self._donate)
+        self.params, self.opt_state, loss = self._multi(
+            self.params, self.opt_state, self._to_device(tokens),
+            jnp.asarray(n_steps, jnp.int32))
         return float(loss)
 
     # -- checkpoint/resume --------------------------------------------------

@@ -423,13 +423,14 @@ class PipelinedLMTrainer:
         self._donate = ((0, 1) if mesh.devices.flat[0].platform == "tpu"
                         else ())
 
-        @_functools.partial(jax.jit, donate_argnums=self._donate)
         def train_step(params, opt_state, tokens):
             loss, grads = mapped(params, tokens)
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        self._step = train_step
+        # raw step kept for run()'s fori_loop body; jitted once here
+        self._step_fn = train_step
+        self._step = jax.jit(train_step, donate_argnums=self._donate)
         self._multi = None   # lazily-built multi-step executable (run())
 
     def run(self, tokens: np.ndarray, n_steps: int) -> float:
@@ -442,27 +443,14 @@ class PipelinedLMTrainer:
         calls for fresh data."""
         import operator
 
-        import jax
         import jax.numpy as jnp
         self._check_batch(tokens)
         n_steps = operator.index(n_steps)   # 2.9 must raise, not run 2
         if n_steps < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         if self._multi is None:
-            inner = self._step.__wrapped__
-
-            # n rides as a TRACED loop bound (fori_loop, not a static
-            # scan length): one executable serves every n_steps — a
-            # per-n recompile of the full 4D program would cost minutes
-            # on real shapes, dwarfing the host-sync latency run() saves
-            @_functools.partial(jax.jit, donate_argnums=self._donate)
-            def multi(params, opt_state, tok, n):
-                def body(_, c):
-                    p, o, _l = c
-                    return inner(p, o, tok)
-                return jax.lax.fori_loop(
-                    0, n, body, (params, opt_state, jnp.float32(0.0)))
-            self._multi = multi
+            from .lm_training import _build_multi_step
+            self._multi = _build_multi_step(self._step_fn, self._donate)
         self.params, self.opt_state, loss = self._multi(
             self.params, self.opt_state, self._to_device(tokens),
             jnp.asarray(n_steps, jnp.int32))

@@ -48,6 +48,34 @@ def test_matches_single_device_training():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_run_multi_step_matches_step_loop():
+    """run(tokens, n) (device-side fori_loop, one host sync) must land on
+    the same trajectory as n step() calls from identical init; n is a
+    traced bound so a different n reuses the compiled executable."""
+    rng = np.random.default_rng(2)
+    toks = _toy_batch(rng, 30, 4, 12)
+    kw = dict(vocab_size=30, mesh=grid_mesh((2, 4)), d_model=32, n_heads=4,
+              n_layers=1, d_ff=64, max_len=16, lr=1e-3, seed=3)
+    a = ShardedLMTrainer(**kw)
+    b = ShardedLMTrainer(**kw)
+    for _ in range(3):
+        last_step = a.step(toks)
+    last_run = b.run(toks, 3)
+    assert abs(last_run - last_step) < 1e-5
+    # traced n: once buffer layouts stabilize (the first call's outputs
+    # can carry new layouts and legitimately retrace), DIFFERENT chunk
+    # sizes must not add compile-cache entries — a static n would
+    # recompile the full program per value
+    b.run(toks, 2)
+    n_compiled = b._multi._cache_size()
+    assert b.run(toks, 4) < last_run
+    b.run(toks, 5)
+    assert b._multi._cache_size() == n_compiled
+    import pytest
+    with pytest.raises(ValueError, match="n_steps"):
+        b.run(toks, 0)
+
+
 def test_head_divisibility_validated():
     with pytest.raises(ValueError, match="model axis"):
         ShardedLMTrainer(vocab_size=10, mesh=grid_mesh((2, 4)), n_heads=6)
