@@ -77,7 +77,17 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "mmlspark_tpu-serving/1.0"
 
     def do_POST(self):  # noqa: N802 (stdlib naming)
-        length = int(self.headers.get("Content-Length", 0))
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            # same status split as the selector transport: 413 for
+            # oversized, 400 for malformed/negative
+            self.send_response(413 if length > MAX_BODY_BYTES else 400)
+            self.end_headers()
+            self.wfile.write(b'{"error": "invalid Content-Length"}')
+            return
         body = self.rfile.read(length)
         cached = CachedRequest(body, dict(self.headers), self.path)
         serving: "ServingServer" = self.server.serving  # type: ignore
@@ -107,11 +117,20 @@ class _ThreadingServer(ThreadingHTTPServer):
     request_queue_size = 128
 
 
-_REASONS = {200: "OK", 502: "Bad Gateway", 504: "Gateway Timeout"}
+_REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
+            501: "Not Implemented", 502: "Bad Gateway",
+            504: "Gateway Timeout"}
+
+# Ingress bounds: a header block or body beyond these is rejected and the
+# connection closed — the single-threaded loop must never be wedged (or its
+# memory grown without bound) by one misbehaving client.
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 64 * 1024 * 1024
 
 
 class _SelectorConn:
-    __slots__ = ("sock", "rbuf", "wbuf", "inflight", "closed")
+    __slots__ = ("sock", "rbuf", "wbuf", "inflight", "closed", "reject",
+                 "closing")
 
     def __init__(self, sock):
         self.sock = sock
@@ -119,6 +138,8 @@ class _SelectorConn:
         self.wbuf = b""
         self.inflight = collections.deque()
         self.closed = False
+        self.reject = None    # pending error response (protocol violation)
+        self.closing = False  # close once wbuf fully drains
 
 
 class _SelectorServer:
@@ -171,11 +192,20 @@ class _SelectorServer:
                     except (BlockingIOError, OSError):
                         pass
                 else:
-                    self._io(conn, mask)
+                    # one connection's failure must close only that
+                    # connection — an uncaught exception here would kill
+                    # the single ingress thread and the whole server
+                    try:
+                        self._io(conn, mask)
+                    except Exception:  # noqa: BLE001
+                        self._close(conn)
             while self._ready:
                 conn = self._ready.popleft()
                 if not conn.closed:
-                    self._flush(conn)
+                    try:
+                        self._flush(conn)
+                    except Exception:  # noqa: BLE001
+                        self._close(conn)
             self._expire()
 
     def _accept(self):
@@ -209,13 +239,40 @@ class _SelectorServer:
         if not data:
             self._close(conn)
             return
+        if conn.reject is not None:
+            return  # desynced stream: ignore further bytes until close
         conn.rbuf += data
         self._parse(conn)
+
+    def _reject(self, conn, status: int, msg: str):
+        """Error reply + close for protocol violations (the connection byte
+        stream can no longer be trusted). HTTP/1.1 responses must stay in
+        request order per connection: if earlier exchanges are still in
+        flight (or partially written), the error is queued AFTER them via
+        conn.reject and the close deferred until the write buffer drains —
+        a direct send() here would splice the error into the middle of a
+        pipelined predecessor's response."""
+        payload = json.dumps({"error": msg}).encode()
+        resp = (f"HTTP/1.1 {status} {_REASONS.get(status, 'Error')}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n").encode("latin-1") + payload
+        if not conn.inflight and not conn.wbuf:
+            try:
+                conn.sock.send(resp)
+            except OSError:
+                pass
+            self._close(conn)
+            return
+        conn.reject = resp
+        self._flush(conn)
 
     def _parse(self, conn):
         while True:
             head_end = conn.rbuf.find(b"\r\n\r\n")
             if head_end < 0:
+                if len(conn.rbuf) > MAX_HEADER_BYTES:
+                    self._reject(conn, 400, "header block too large")
                 return
             head = conn.rbuf[:head_end].decode("latin-1")
             lines = head.split("\r\n")
@@ -228,7 +285,21 @@ class _SelectorServer:
             for ln in lines[1:]:
                 k, _, v = ln.partition(":")
                 headers[k.strip().lower()] = v.strip()
-            length = int(headers.get("content-length", 0))
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                # chunked framing isn't parsed here; accepting it would
+                # desync every later request on this connection
+                self._reject(conn, 501, "chunked transfer-encoding "
+                                        "not supported")
+                return
+            try:
+                length = int(headers.get("content-length", 0))
+            except ValueError:
+                self._reject(conn, 400, "malformed Content-Length")
+                return
+            if length < 0 or length > MAX_BODY_BYTES:
+                self._reject(conn, 400 if length < 0 else 413,
+                             "invalid Content-Length")
+                return
             total = head_end + 4 + length
             if len(conn.rbuf) < total:
                 return
@@ -259,8 +330,16 @@ class _SelectorServer:
             out.append(payload)
         if out:
             conn.wbuf += b"".join(out)
+        if conn.reject is not None and not conn.inflight:
+            # every predecessor answered in order; the error goes last,
+            # then the connection closes once the buffer drains
+            conn.wbuf += conn.reject
+            conn.reject = None
+            conn.closing = True
         if conn.wbuf:
             self._send_buffered(conn)
+        elif conn.closing:
+            self._close(conn)
 
     def _send_buffered(self, conn):
         try:
@@ -269,6 +348,9 @@ class _SelectorServer:
         except (BlockingIOError, InterruptedError):
             pass
         except OSError:
+            self._close(conn)
+            return
+        if conn.closing and not conn.wbuf:
             self._close(conn)
             return
         # partial write: watch writability until the buffer drains, then
@@ -289,6 +371,11 @@ class _SelectorServer:
             _, req = self._deadlines.pop(rid)
             if not req._event.is_set():
                 req.respond(504, b'{"error": "serving timeout"}')
+                # drop the dead exchange from routing so workers draining a
+                # batch skip it (its _event is set; _process filters those)
+                # instead of scoring into a 504'd socket
+                with self.serving._lock:
+                    self.serving._routing.pop(rid, None)
 
     def _close(self, conn):
         conn.closed = True
@@ -495,6 +582,8 @@ class ServingQuery:
                     # (reference: ServingUDFs' row-level errorCol
                     # short-circuit; round-2 verdict weak #9)
                     for r in batch:
+                        if r._event.is_set():
+                            continue  # already answered (expired to 504)
                         try:
                             reply = self.transform_fn([r.body])[0]
                             self.server.reply_to(r.id, reply)
@@ -509,9 +598,14 @@ class ServingQuery:
                     time.sleep(0.01 * replays)
 
     def _process(self, pid: int, epoch: int, batch: list):
-        bodies = [r.body for r in batch]
+        # skip exchanges already answered (expired to 504 by the transport):
+        # the transform would be wasted compute into a dead socket
+        live = [r for r in batch if not r._event.is_set()]
+        if not live:
+            return
+        bodies = [r.body for r in live]
         replies = self.transform_fn(bodies)
-        for r, reply in zip(batch, replies):
+        for r, reply in zip(live, replies):
             self.server.reply_to(r.id, reply)
 
     def stop(self):

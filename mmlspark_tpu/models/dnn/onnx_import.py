@@ -270,7 +270,16 @@ def _eval_node(node, env):
                  for i, s in enumerate(shape)]
         return x[0].reshape(shape)
     if op == "Constant":
-        return jnp.asarray(att["value"])
+        # the tensor form ("value") plus the scalar/list attribute forms
+        # torch and other exporters emit for small constants
+        for key in ("value", "value_float", "value_int", "value_floats",
+                    "value_ints"):
+            if key in att:
+                return jnp.asarray(att[key])
+        raise NotImplementedError(
+            f"Constant node '{node['name']}': unsupported attribute form "
+            f"{sorted(att)} (supported: value/value_float/value_int/"
+            f"value_floats/value_ints)")
     if op == "Conv":
         if att.get("group", 1) != 1:
             raise NotImplementedError(
@@ -338,18 +347,36 @@ def load_onnx(data) -> tuple:
     outputs = g["outputs"]
     nodes = g["nodes"]
 
+    # Only a node's FIRST output is produced (e.g. BatchNormalization's
+    # training outputs are unused in inference graphs). Refuse at LOAD
+    # time, by name, any graph that actually consumes a secondary output —
+    # deferring this surfaced as a bare KeyError deep in evaluation
+    # (round-4 advisor).
+    secondary = {}
+    for node in nodes:
+        for out in node["outputs"][1:]:
+            if out:
+                secondary[out] = (node["op"], node["name"])
+    for node in nodes:
+        for inp in node["inputs"]:
+            if inp in secondary:
+                op, name = secondary[inp]
+                raise NotImplementedError(
+                    f"node '{node['name']}' consumes '{inp}', a secondary "
+                    f"output of {op} node '{name}' — only first outputs "
+                    f"are evaluated")
+    for out in outputs:
+        if out in secondary:
+            op, name = secondary[out]
+            raise NotImplementedError(
+                f"graph output '{out}' is a secondary output of {op} node "
+                f"'{name}' — only first outputs are evaluated")
+
     def apply_fn(p, x):
         env = dict(p)
         env[feed] = x
         for node in nodes:
-            vals = _eval_node(node, env)
-            outs = node["outputs"]
-            if len(outs) == 1:
-                env[outs[0]] = vals
-            else:
-                # ops like BatchNormalization may declare unused training
-                # outputs; only the first is produced here
-                env[outs[0]] = vals
+            env[node["outputs"][0]] = _eval_node(node, env)
         res = [env[o] for o in outputs]
         return res[0] if len(res) == 1 else tuple(res)
 

@@ -1,3 +1,18 @@
+"""TPU compute ops.
+
+API-level gradient contract for `flash_attention_stats` (ops.flash_attention):
+its flash VJP drops the `m` cotangent, so gradients are exact ONLY for
+shift-invariant consumers of (acc, m, l) — ones unchanged under
+(acc e^{-d}, m + d, l e^{-d}), which the ring-attention merge satisfies.
+A consumer that differentiates a non-shift-invariant readout of the raw
+stats silently gets wrong gradients; set
+`flash_attention.DEBUG_STATS_EXACT_VJP = True` to route gradients through
+the dense XLA reference (exact for ALL consumers, O(S^2) memory) and
+compare. The flag is read at TRACE time — flip it before building the
+jitted function you compare (an already-compiled function keeps the flash
+path). `flash_attention` itself (the normalized entry point) is exact for
+every consumer.
+"""
 from .binning import BinMapper, fit_bins, apply_bins, bin_threshold_value
 from .histogram import node_feature_histograms
 

@@ -263,6 +263,19 @@ def _stats_xla_reference(q, k, v, q_offset, k_offset, causal, scale):
     return acc, m, l
 
 
+# Debug escape hatch for the shift-invariance gradient contract (see
+# _flash_stats_bwd and the ops package docstring): when True, stats
+# gradients route through the dense XLA reference VJP — exact for ALL
+# consumers including non-shift-invariant readouts of (acc, m, l), at
+# O(S^2) memory. Flip it to verify a new consumer's gradients match the
+# flash path before trusting the O(block) backward.
+# TRACE-TIME flag: it is read when the backward is traced, so a jitted
+# function compiled before the flip keeps the flash path — flip it BEFORE
+# building the jit (or call jax.clear_caches()); comparing two calls of
+# one already-compiled function compares the flash path against itself.
+DEBUG_STATS_EXACT_VJP = False
+
+
 def _flash_stats_fwd(q, k, v, q_offset, k_offset, causal, scale, block_q,
                      block_k, interpret):
     out = _flash_stats_forward(q, k, v, q_offset, k_offset, causal, scale,
@@ -291,6 +304,17 @@ def _flash_stats_bwd(causal, scale, block_q, block_k, interpret, res, g):
     rows)."""
     import jax.dtypes
     q, k, v, q_offset, k_offset, m = res
+    if DEBUG_STATS_EXACT_VJP:
+        # exact-for-all-consumers reference path: differentiates the dense
+        # stats (including the m cotangent) so a new consumer can check
+        # its gradients against the flash path (ops package docstring)
+        zero = np.zeros((), jax.dtypes.float0)
+        _, ref_vjp = jax.vjp(
+            lambda qq, kk, vv: _stats_xla_reference(
+                qq, kk, vv, q_offset, k_offset, causal, scale), q, k, v)
+        dq, dk, dv = ref_vjp(tuple(x.astype(jnp.float32) for x in g))
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+                zero, zero)
     qh = jnp.moveaxis(q, 1, 0)    # (H, S, D)
     kh = jnp.moveaxis(k, 1, 0)
     vh = jnp.moveaxis(v, 1, 0)
@@ -678,9 +702,14 @@ def flash_attention(q, k, v, causal: bool = False,
         q.shape[0], k.shape[0], q.dtype)
     bq = int(block_q) if block_q is not None else a_bq
     bk = int(block_k) if block_k is not None else a_bk
-    # explicit blocks pin the backward too (sweep scripts rely on that)
-    bwd_bq = int(block_q) if block_q is not None else a_bwd_bq
-    bwd_bk = int(block_k) if block_k is not None else a_bwd_bk
+    # explicit blocks pin the backward too (sweep scripts rely on that) —
+    # but capped by the dtype VMEM ceiling: an f32 caller passing
+    # block_q=1024 would otherwise hit the documented f32-backward VMEM
+    # compile failure only at grad time (round-4 advisor)
+    bwd_cap = (_BWD_BLOCK_BF16 if jnp.dtype(q.dtype) == jnp.bfloat16
+               else _BWD_BLOCK_F32)
+    bwd_bq = min(int(block_q), bwd_cap) if block_q is not None else a_bwd_bq
+    bwd_bk = min(int(block_k), bwd_cap) if block_k is not None else a_bwd_bk
     qh = jnp.moveaxis(q, 1, 0)                # (H, S, D)
     kh = jnp.moveaxis(jnp.asarray(k), 1, 0)
     vh = jnp.moveaxis(jnp.asarray(v), 1, 0)

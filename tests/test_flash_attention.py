@@ -225,6 +225,66 @@ def test_stats_flash_backward_matches_dense_reference():
             assert rel < 2e-4, (q_off, k_off, causal, name, rel)
 
 
+def test_explicit_blocks_cap_f32_backward(monkeypatch):
+    """An f32 caller passing block_q=1024 must NOT pin the backward at
+    1024 — that is the documented f32-backward VMEM compile failure, and
+    it would surface only at grad time (round-4 advisor). The cap is the
+    same dtype ceiling _auto_blocks applies."""
+    import mmlspark_tpu.ops.flash_attention as fa
+    seen = {}
+    real = fa._flash_shd
+
+    def spy(q, k, v, causal, scale, bq, bk, bwd_bq, bwd_bk, interpret):
+        seen.update(bq=bq, bk=bk, bwd_bq=bwd_bq, bwd_bk=bwd_bk)
+        return real(q, k, v, causal, scale, bq, bk, bwd_bq, bwd_bk,
+                    interpret)
+
+    monkeypatch.setattr(fa, "_flash_shd", spy)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(64, 1, 32)), jnp.float32)
+    fa.flash_attention(q, q, q, causal=True, block_q=1024, block_k=1024,
+                       interpret=True)
+    assert seen["bq"] == seen["bk"] == 1024       # forward stays pinned
+    assert seen["bwd_bq"] == seen["bwd_bk"] == fa._BWD_BLOCK_F32
+    # bf16 keeps the full pin (its backward fits VMEM at 1024)
+    fa.flash_attention(q.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+                       q.astype(jnp.bfloat16), causal=True, block_q=1024,
+                       block_k=1024, interpret=True)
+    assert seen["bwd_bq"] == 1024
+
+
+def test_stats_debug_exact_vjp_path():
+    """DEBUG_STATS_EXACT_VJP routes stats gradients through the dense
+    reference (exact for ALL consumers) — for a shift-invariant consumer
+    it must agree with the flash backward, which is how a new consumer
+    verifies its own gradients before trusting the O(block) path."""
+    import mmlspark_tpu.ops.flash_attention as fa
+    rng = np.random.default_rng(7)
+    s, h, d = 128, 2, 32
+    q = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(s, h, d)), jnp.float32)
+
+    def loss(q, k, v):
+        acc, m, l = fa.flash_attention_stats(q, k, v, q_offset=0, k_offset=0,
+                                             causal=True, scale=0.125)
+        wgt = jnp.exp(jnp.minimum(m, 50.0))
+        num = jnp.moveaxis(acc, 0, 1) * wgt[..., None]
+        den = l * wgt + 1e-9
+        return (jnp.moveaxis(num / den[..., None], 0, 1) * w).sum()
+
+    g_flash = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    try:
+        fa.DEBUG_STATS_EXACT_VJP = True
+        g_exact = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa.DEBUG_STATS_EXACT_VJP = False
+    for name, a, b in zip("qkv", g_flash, g_exact):
+        rel = float(jnp.abs(a - b).max()) / (float(jnp.abs(b).max()) + 1e-9)
+        assert rel < 2e-4, (name, rel)
+
+
 def test_flash_backward_through_jit_and_composition():
     """grad-of-jit over a small transformer-block-like composition: the
     custom VJP must thread through scan/jit without shape surprises."""

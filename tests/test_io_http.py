@@ -340,6 +340,68 @@ def test_poison_row_isolated_from_batch():
         server.stop()
 
 
+def test_serving_malformed_ingress_survives():
+    """Protocol violations must close ONE connection, never the server:
+    a malformed Content-Length used to raise ValueError out of the single
+    selector thread and kill ingress for everyone (round-4 advisor,
+    severity medium). Each bad client gets a 4xx/close; the next good
+    request must still answer 200."""
+    import socket as _socket
+    server = ServingServer(num_partitions=1).start()
+    q = ServingQuery(server, lambda bodies: [{"ok": 1} for _ in bodies],
+                     poll_timeout=0.005).start()
+    host, port = server._httpd.server_address[:2]
+
+    def raw(payload: bytes) -> bytes:
+        with _socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(payload)
+            chunks = []
+            try:
+                while True:
+                    c = s.recv(4096)
+                    if not c:
+                        break
+                    chunks.append(c)
+            except OSError:
+                pass
+            return b"".join(chunks)
+
+    try:
+        _post(server.address, {"warm": 1})
+        # non-numeric Content-Length -> 400, not a dead server
+        r = raw(b"POST / HTTP/1.1\r\nContent-Length: banana\r\n\r\n")
+        assert b"400" in r.split(b"\r\n", 1)[0], r[:80]
+        # negative Content-Length -> 400
+        r = raw(b"POST / HTTP/1.1\r\nContent-Length: -5\r\n\r\n")
+        assert b"400" in r.split(b"\r\n", 1)[0], r[:80]
+        # chunked framing is refused loudly (would desync the stream)
+        r = raw(b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n0\r\n\r\n")
+        assert b"501" in r.split(b"\r\n", 1)[0], r[:80]
+        # oversized declared body -> 413
+        r = raw(b"POST / HTTP/1.1\r\nContent-Length: 99999999999\r\n\r\n")
+        assert b"413" in r.split(b"\r\n", 1)[0], r[:80]
+        # runaway header block (no terminator) -> bounded, not OOM
+        r = raw(b"POST / HTTP/1.1\r\n" + b"X-Filler: " + b"a" * 70000)
+        assert b"400" in r.split(b"\r\n", 1)[0], r[:80]
+        # pipelined valid-then-malformed: the valid request's response
+        # must arrive FIRST and intact (HTTP/1.1 in-order responses);
+        # the error splicing ahead of it would corrupt the exchange
+        body = json.dumps({"x": 2}).encode()
+        r = raw(b"POST / HTTP/1.1\r\nContent-Length: %d\r\n\r\n%s"
+                b"POST / HTTP/1.1\r\nContent-Length: nope\r\n\r\n"
+                % (len(body), body))
+        first, rest = r.split(b"\r\n\r\n", 1)
+        assert b"200" in first.split(b"\r\n", 1)[0], r[:120]
+        assert rest.startswith(b'{"ok": 1}'), rest[:40]
+        assert b"400" in rest, rest[:200]
+        # the server is still alive and serving
+        assert _post(server.address, {"x": 1}) == {"ok": 1}
+    finally:
+        q.stop()
+        server.stop()
+
+
 def test_serving_epoch_commit_gc():
     server = ServingServer(num_partitions=1).start()
     q = ServingQuery(server, lambda bodies: [{} for _ in bodies]).start()

@@ -99,6 +99,42 @@ def test_unsupported_op_is_named():
         onnx_import._eval_node(node, {})
 
 
+def test_constant_attribute_forms():
+    """Constant nodes carry value_float/value_int/value_ints in many
+    exporters — these evaluate; an unknown form raises with the node name
+    instead of a bare KeyError (round-4 advisor)."""
+    from mmlspark_tpu.models.dnn import onnx_import
+    for attrs, expect in [({"value_float": 2.5}, 2.5),
+                          ({"value_int": 7}, 7),
+                          ({"value_ints": [1, 2, 3]}, [1, 2, 3]),
+                          ({"value_floats": [0.5, 1.5]}, [0.5, 1.5])]:
+        node = {"op": "Constant", "name": "c", "inputs": [],
+                "outputs": ["y"], "attrs": attrs}
+        np.testing.assert_allclose(
+            np.asarray(onnx_import._eval_node(node, {})), expect)
+    bad = {"op": "Constant", "name": "cbad", "inputs": [], "outputs": ["y"],
+           "attrs": {"sparse_value": object()}}
+    with pytest.raises(NotImplementedError, match="cbad.*sparse_value"):
+        onnx_import._eval_node(bad, {})
+
+
+def test_secondary_output_consumption_refused_at_load():
+    """A graph consuming a node's secondary output must be refused at
+    LOAD time with both node names — only first outputs are evaluated."""
+    from mmlspark_tpu.models.dnn import onnx_import
+    g = {"nodes": [
+            {"op": "BatchNormalization", "name": "bn1", "inputs": ["x"],
+             "outputs": ["y", "saved_mean"], "attrs": {}},
+            {"op": "Relu", "name": "r1", "inputs": ["saved_mean"],
+             "outputs": ["z"], "attrs": {}}],
+         "initializers": {}, "inputs": ["x"], "outputs": ["z"]}
+    import unittest.mock as mock
+    with mock.patch.object(onnx_import, "parse_onnx", return_value=g):
+        with pytest.raises(NotImplementedError,
+                           match="r1.*saved_mean.*bn1"):
+            onnx_import.load_onnx(b"ignored")
+
+
 def test_wire_reader_roundtrip_basics():
     """Hand-assembled protobuf fragments decode as expected (varints,
     packed ints, fixed32 floats, nested messages)."""
