@@ -1,0 +1,76 @@
+"""Serving load generator: N concurrent keep-alive HTTP clients against a
+ServingServer, with latency bookkeeping.
+
+Shared by the serving benches (bench.py BENCH_MODE=serving) and the
+throughput-floor tests (tests/test_io_http.py) so the harness — error
+capture, wall-clock accounting, percentile math — has exactly one
+implementation (role: the reference's serving load suites drive
+WorkerServer the same way, HTTPv2Suite throughput tests)."""
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from typing import Callable, NamedTuple, Optional
+
+
+class LoadResult(NamedTuple):
+    req_per_sec: float
+    p50_ms: float
+    p99_ms: float
+    n_ok: int
+    errors: list
+    latencies_s: list   # sorted
+
+
+def run_load(host: str, port: int, body: str, n_clients: int = 16,
+             per_client: int = 125, timeout: float = 30.0,
+             check: Optional[Callable] = None) -> LoadResult:
+    """Hammer POST / with n_clients keep-alive connections; returns
+    sustained req/s over the whole run plus p50/p99 latency. `check`
+    (status, payload_bytes) raises to fail a response; default accepts
+    any 200."""
+    lat: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def default_check(status, payload):
+        assert status == 200, (status, payload[:80])
+
+    chk = check or default_check
+
+    def client(cid):
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/", body=body)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    chk(resp.status, payload)
+                    with lock:
+                        lat.append(time.perf_counter() - t0)
+                except Exception as e:  # noqa: BLE001 - reported to caller
+                    with lock:
+                        errors.append(e)
+                    return
+        finally:
+            conn.close()
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    if not lat:
+        return LoadResult(0.0, float("inf"), float("inf"), 0, errors, lat)
+    return LoadResult(
+        req_per_sec=len(lat) / wall,
+        p50_ms=lat[len(lat) // 2] * 1000,
+        p99_ms=lat[int(len(lat) * 0.99)] * 1000,
+        n_ok=len(lat), errors=errors, latencies_s=lat)

@@ -30,17 +30,33 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
     dtype = Param("dtype", "on-device compute dtype", "bfloat16")
 
     def __init__(self, model_name: str = "resnet18", variables=None,
-                 num_classes: int = 1000, seed: int = 0, **kw):
+                 num_classes: int = 1000, seed: int = 0,
+                 onnx_model=None, **kw):
+        """onnx_model: ONNX bytes or a path — scores a FOREIGN model
+        through the hand-rolled importer (models/dnn/onnx_import.py)
+        instead of the zoo, with the same layer-cut semantics: the
+        reference's ImageFeaturizer exists precisely to featurize
+        downloaded models it did not define (ImageFeaturizer.scala:
+        40-215). ONNX graphs are NCHW; the featurizer's NHWC image
+        batches are transposed at the boundary."""
         kw.setdefault("input_col", "image")
         kw.setdefault("output_col", "features")
         super().__init__(**kw)
+        self._onnx_bytes = None
+        if onnx_model is not None:
+            if isinstance(onnx_model, str):
+                with open(onnx_model, "rb") as f:
+                    onnx_model = f.read()
+            self._onnx_bytes = bytes(onnx_model)
+            model_name = "onnx"
         self.set(model_name=model_name)
         self._variables = variables
         self._num_classes = num_classes
         self._seed = seed
         self._dnn: Optional[DNNModel] = None
 
-    model_name = Param("model_name", "zoo model (resnet18|resnet50)", "resnet18")
+    model_name = Param("model_name", "zoo model (resnet18|resnet50) or "
+                                     "'onnx' (use onnx_model=)", "resnet18")
 
     def set_model(self, schema) -> "ImageFeaturizer":
         """Accept a downloader ModelSchema (reference: setModel,
@@ -52,18 +68,28 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
 
     def _get_state(self):
         import jax
+        state = {}
+        if self._onnx_bytes is not None:
+            state["onnx_bytes"] = np.frombuffer(self._onnx_bytes, np.uint8)
+            if getattr(self, "_variables_from_onnx", False):
+                # weights are exactly load_onnx(bytes) — storing the
+                # leaves too would double the artifact
+                return state
         if self._variables is None:
-            return {}
+            return state
         from .model import _treedef_to_str
         leaves, _ = jax.tree_util.tree_flatten(self._variables)
-        state = {"treedef": _treedef_to_str(self._variables),
-                 "n_leaves": len(leaves)}
+        state.update({"treedef": _treedef_to_str(self._variables),
+                      "n_leaves": len(leaves)})
         for i, leaf in enumerate(leaves):
             state[f"leaf_{i}"] = np.asarray(leaf)
         return state
 
     def _set_state(self, s):
         from .model import _treedef_from_str
+        if "onnx_bytes" in s:
+            self._onnx_bytes = np.asarray(s["onnx_bytes"],
+                                          np.uint8).tobytes()
         n = int(np.asarray(s.get("n_leaves", 0)))
         if n:
             leaves = [np.asarray(s[f"leaf_{i}"]) for i in range(n)]
@@ -72,6 +98,36 @@ class ImageFeaturizer(Model, HasInputCol, HasOutputCol):
     def _build(self):
         import jax.numpy as jnp
         from . import resnet as zoo
+        if self.model_name == "onnx":
+            if self._onnx_bytes is None:
+                raise ValueError(
+                    "model_name='onnx' requires the onnx_model= bytes "
+                    "(they are serialized with the stage)")
+            from .onnx_import import load_onnx
+            raw_apply, params = load_onnx(
+                self._onnx_bytes,
+                cut="features" if self.cut_output_layers else None)
+            dtype = jnp.dtype(self.dtype)
+
+            def apply_fn(p, xb):        # NHWC featurizer batch -> NCHW
+                x = jnp.transpose(xb, (0, 3, 1, 2)).astype(dtype)
+                pc = {k: v.astype(dtype)
+                      if v.dtype == jnp.float32 else v
+                      for k, v in p.items()}
+                return raw_apply(pc, x).astype(jnp.float32)
+
+            # remember whether the params came straight from the bytes:
+            # serializing both would double the artifact for information
+            # load_onnx reconstructs deterministically
+            self._variables_from_onnx = self._variables is None
+            self._variables = params if self._variables is None \
+                else self._variables
+            self._dnn = DNNModel(apply_fn=apply_fn,
+                                 params=self._variables,
+                                 input_col="__img_in",
+                                 output_col=self.output_col,
+                                 batch_size=self.batch_size)
+            return
         cut = "features" if self.cut_output_layers else "logits"
         dtype = jnp.dtype(self.dtype)
         maker = {"resnet18": zoo.resnet18, "resnet50": zoo.resnet50}[self.model_name]

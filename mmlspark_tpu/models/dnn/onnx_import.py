@@ -24,6 +24,8 @@ independent implementations.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 # -- protobuf wire format ----------------------------------------------------
@@ -326,13 +328,21 @@ def _eval_node(node, env):
         f"inference opset — see onnx_import.py docstring")
 
 
-def load_onnx(data) -> tuple:
+def load_onnx(data, cut: Optional[str] = None) -> tuple:
     """ONNX bytes/path -> (apply_fn, params) for DNNModel.
 
     apply_fn(params, x) evaluates the graph on the (single) graph input
     with the initializers as the params pytree — so the imported model
     serializes, jits, and exports exactly like a native one.
+
+    cut="features" drops the classifier head: evaluation stops at the
+    input of the LAST Gemm/MatMul node (for a ResNet-class graph that is
+    the pooled+flattened feature vector) — the transfer-learning layer
+    cut ImageFeaturizer performs on foreign models (reference:
+    cutOutputLayers, image/ImageFeaturizer.scala:100-108).
     """
+    if cut not in (None, "features"):
+        raise ValueError(f"cut must be None|'features', got {cut!r}")
     if isinstance(data, str):
         with open(data, "rb") as f:
             data = f.read()
@@ -346,6 +356,15 @@ def load_onnx(data) -> tuple:
     feed = feed_inputs[0]
     outputs = g["outputs"]
     nodes = g["nodes"]
+    if cut == "features":
+        head = [i for i, nd in enumerate(nodes)
+                if nd["op"] in ("Gemm", "MatMul")]
+        if not head:
+            raise ValueError(
+                "cut='features' needs a Gemm/MatMul classifier head to "
+                "drop; this graph has none")
+        nodes = nodes[:head[-1]]
+        outputs = [g["nodes"][head[-1]]["inputs"][0]]
 
     # Only a node's FIRST output is produced (e.g. BatchNormalization's
     # training outputs are unused in inference graphs). Refuse at LOAD

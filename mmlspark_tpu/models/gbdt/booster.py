@@ -23,6 +23,21 @@ from . import trainer
 # and unrolled masked loops stop paying off; the host DFS takes over
 _DEVICE_SHAP_MAX_DEPTH = 8
 
+# raw_score batches under this row count score on the HOST (vectorized
+# numpy descent): a serving microbatch must not pay a device dispatch
+# round trip per batch — the reference's serving scenario is exactly
+# executor-LOCAL model scoring (HTTPSourceV2 pipelines run on the
+# executor, docs/mmlspark-serving.md:142-146). Measured on the dev
+# tunnel: device scoring capped serving at ~176 req/s; host scoring of a
+# 256-row batch through 20 trees is ~100 us. Large batches still take
+# the jitted device scan (bulk inference throughput, BENCH_MODE=predict),
+# and so do big ENSEMBLES on mid-size batches: the host loop is
+# O(rows x trees x depth) python-dispatched numpy, so the auto route
+# also caps total element-ops (a 2000-tree model on 4000 rows would be
+# seconds on host vs milliseconds on device).
+_HOST_PREDICT_MAX_ROWS = 4096
+_HOST_PREDICT_MAX_WORK = 20_000_000   # rows * trees * depth element-ops
+
 
 class Booster(NamedTuple):
     split_feature: np.ndarray   # (T, max_nodes) i32, -1 = leaf
@@ -63,16 +78,37 @@ class Booster(NamedTuple):
         return slice(None)
 
     # -- scoring -----------------------------------------------------------
-    def raw_score(self, x, init_score: float = 0.0):
-        """(n, F) f32 -> (n, n_classes) raw margins."""
+    def raw_score(self, x, init_score: float = 0.0, backend: str = "auto"):
+        """(n, F) f32 -> (n, n_classes) raw margins.
+
+        backend: "auto" scores small batches (< _HOST_PREDICT_MAX_ROWS)
+        on the host — the serving hot path must stay dispatch-free — and
+        bulk batches on the device; "host"/"device" force a path. Both
+        run the identical descent (go right unless x <= threshold, NaN
+        right, categorical membership on identity bins) and agree
+        bitwise (tests/test_gbdt.py::test_host_device_raw_score_parity).
+        """
+        if backend not in ("auto", "host", "device"):
+            raise ValueError(
+                f"backend must be auto|host|device, got {backend!r}")
+        x = np.asarray(x, dtype=np.float32)
         s = self._used_trees()
         ic, cw = self._cat_args(s)
-        out = trainer.predict_raw(
-            np.asarray(x, dtype=np.float32),
-            self.split_feature[s], self.threshold[s], self.leaf_value[s],
-            self.tree_class[s], self.max_depth, self.n_classes,
-            split_is_cat=ic, cat_words=cw)
-        return np.asarray(out) + init_score
+        n_used = len(range(*s.indices(self.split_feature.shape[0])))
+        work = x.shape[0] * n_used * max(self.max_depth, 1)
+        if backend == "host" or (backend == "auto"
+                                 and x.shape[0] < _HOST_PREDICT_MAX_ROWS
+                                 and work <= _HOST_PREDICT_MAX_WORK):
+            out = _predict_raw_host(
+                x, self.split_feature[s], self.threshold[s],
+                self.leaf_value[s], self.tree_class[s], self.max_depth,
+                self.n_classes, split_is_cat=ic, cat_words=cw)
+        else:
+            out = np.asarray(trainer.predict_raw(
+                x, self.split_feature[s], self.threshold[s],
+                self.leaf_value[s], self.tree_class[s], self.max_depth,
+                self.n_classes, split_is_cat=ic, cat_words=cw))
+        return out + init_score
 
     def predict_leaf(self, x):
         s = self._used_trees()
@@ -278,6 +314,45 @@ class Booster(NamedTuple):
             gain=np.concatenate([a[4], b[4]]) if both_aux else None,
             cover=np.concatenate([a[5], b[5]]) if both_aux else None,
             split_is_cat=ic, cat_words=cw)
+
+
+def _predict_raw_host(x, split_feature, threshold, leaf_value, tree_class,
+                      max_depth: int, n_classes: int,
+                      split_is_cat=None, cat_words=None):
+    """Vectorized numpy ensemble descent — the host mirror of
+    trainer._predict_raw_gather with identical routing semantics: go
+    right unless x <= threshold (NaN compares False -> routes right,
+    missing = largest), categorical nodes route by membership of the
+    value's identity bin in the packed 16-bit words (raw_to_cat_bin).
+    Exists for the serving hot path: executor-local scoring with no
+    device dispatch (reference: HTTPSourceV2 pipelines score on the
+    executor; LightGBM predict is likewise CPU-local)."""
+    n = x.shape[0]
+    rows = np.arange(n)
+    scores = np.zeros((n, n_classes), np.float32)
+    has_cat = (split_is_cat is not None and cat_words is not None
+               and cat_words.shape[-1] > 0)
+    for t in range(split_feature.shape[0]):
+        sf_t, thr_t, lv_t = split_feature[t], threshold[t], leaf_value[t]
+        node = np.zeros(n, np.int32)
+        for _ in range(max_depth):
+            f = sf_t[node]
+            is_leaf = f < 0
+            xf = x[rows, np.clip(f, 0, x.shape[1] - 1)]
+            with np.errstate(invalid="ignore"):
+                go_left = xf <= thr_t[node]
+            if has_cat:
+                w16 = cat_words.shape[-1]
+                top = w16 * 16 - 1
+                b = np.clip(np.ceil(xf - 0.5), 0, top)
+                b = np.where(np.isnan(xf), top, b).astype(np.int32)
+                words = cat_words[t][node]                    # (n, w16)
+                member = ((words[rows, b >> 4] >> (b & 15)) & 1) == 1
+                go_left = np.where(split_is_cat[t][node], member, go_left)
+            child = np.where(go_left, 2 * node + 1, 2 * node + 2)
+            node = np.where(is_leaf, node, child).astype(np.int32)
+        scores[rows, tree_class[t]] += lv_t[node]
+    return scores
 
 
 def _pad_depth(b: Booster, max_depth: int):

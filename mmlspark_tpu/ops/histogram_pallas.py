@@ -50,13 +50,50 @@ M_MAX = 64  # max nodes per level handled here (VMEM bound on the 3m columns)
 # one-hot work plus 3m x (B/LO_BINS) x T of node-weight outer product;
 # measured on v5e at 1M x 128 x 256: 13.4/15.4/22.6 ms for m=1/2/4 vs a flat
 # 26.9 ms direct; at m >= 8 the outer product overtakes the saving (43.6
-# ms) so deeper levels stay direct. n_hi = 8 aligns the (3m, n_hi, T)
-# outer product with the 8-sublane hardware tile (n_hi = 4 measured 30%
-# SLOWER despite fewer ops). Routed when n_bins >= FACTORED_MIN_BINS and
-# m <= FACTORED_M_MAX.
+# ms). n_hi = 8 aligns the (3m, n_hi, T) outer product with the 8-sublane
+# hardware tile (n_hi = 4 measured 30% SLOWER despite fewer ops).
+# SUPERSEDED by the joint-key kernel below, which beats it at every m
+# (12.0 vs 12.4 even at m=1) — FACTORED_M_MAX=0 retires the route; the
+# kernel stays for the measurement history and as the joint kernel's
+# structural ancestor.
 FACTORED_MIN_BINS = 128
-FACTORED_M_MAX = 4
+FACTORED_M_MAX = 0
 LO_BINS = 32
+
+# JOINT-key radix kernel (round-5): factor the COMBINED key
+# k = node * B + bin as k = hi * LO + lo, so the node dimension rides the
+# hi one-hot instead of a 3m-row outer product — the per-(feature, tile)
+# VPU cost is ~(4mB/LO + LO) units against the direct kernel's (3m + B),
+# minimized at LO ~= 2*sqrt(mB). Measured on v5e at 1M x 128 x 256
+# (10-rep steady state):
+#
+#     m        1      2      4      8      16     (32+)
+#     direct   26.8   26.8   26.8   26.8   26.8   26.8
+#     old      12.4   14.5   21.7   43.6*  --         (separate-node, LO=32)
+#     joint64  12.0   11.7   13.6   25.6   42.4
+#     joint128 16.5   17.2   21.8   17.8   23.1
+#
+# (*round-4 measurement.) Routing below picks the measured winner per m:
+# m <= 4 joint LO=64, m in {8, 16} joint LO=128, m >= 32 direct (joint's
+# hi one-hot outgrows the saving). LO ~= 2*sqrt(mB) is the analytic
+# optimum of the (4mB/LO + LO) VPU-unit model; the in-graph numbers
+# (XLA CSEs the bins transpose, no per-call dispatch) run ~5 ms faster
+# per call than this standalone table and follow the same ordering.
+# Also measured and REJECTED:
+# - row compaction (gather the ~50% live rows pre-kernel): at 1M rows
+#   the compaction costs 9.7 ms (nonzero) + 14.9 ms (row gather of
+#   (1M,128) u8) + ~9 ms per (1M,) f32 stat gather — TPU gathers run
+#   ~10 GB/s, far under the 6-14 ms/level the halved kernel would save;
+# - feature grouping (G features share one (G*rows, T)@(T, G*LO) MXU
+#   pass, diagonal blocks kept): 5-15% SLOWER at every (m, G) tried —
+#   the fixed per-level cost is not small-matmul streaming;
+# - TILE_ROWS 16384/32768: flat (not per-grid-cell-overhead-bound).
+JOINT_MIN_BINS = 128
+JOINT_M_MAX = 16
+
+
+def _joint_lo(m: int) -> int:
+    return 64 if m <= 4 else 128
 
 
 def _hist_kernel(bins_ref, node_ref, g_ref, h_ref, c_ref, hg_ref, hh_ref,
@@ -151,6 +188,52 @@ def _hist_kernel_factored(bins_ref, node_ref, g_ref, h_ref, c_ref, hg_ref,
         hc_ref[i] += res[2 * m * n_hi:].reshape(m, n_hi, LO_BINS)
 
 
+def _hist_kernel_joint(bins_ref, node_ref, g_ref, h_ref, c_ref, hg_ref,
+                       hh_ref, hc_ref, *, m: int, n_hi: int, lo_bins: int,
+                       n_bins: int):
+    """Joint-key radix kernel: k = node * n_bins + bin factored over
+    (hi, lo). The stats ride as THREE rows (no node dimension); the node
+    enters through the hi one-hot, so the outer-product lift costs
+    3 * n_hi * T instead of the separate-node variant's 3m * n_hi_b * T —
+    that is what keeps deep levels (m = 8, 16) ahead of the direct
+    kernel (measured table at the top of this file). Inactive rows carry
+    key -1 -> hi -1, matching no hi one-hot row, so they vanish exactly
+    like the direct kernel's node mask. (A count-plane shortcut — with
+    unit counts the c lift IS hi_oh — was measured and REJECTED: the
+    concatenate's layout copy costs more than the saved multiplies.)"""
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        hg_ref[...] = jnp.zeros_like(hg_ref)
+        hh_ref[...] = jnp.zeros_like(hh_ref)
+        hc_ref[...] = jnp.zeros_like(hc_ref)
+
+    node = node_ref[0, :]
+    g = g_ref[0, :]
+    h = h_ref[0, :]
+    c = c_ref[0, :]
+    T = node.shape[0]
+    w3 = jnp.stack([g, h, c], axis=0).astype(jnp.bfloat16)   # (3, T)
+    valid = (node >= 0) & (node < m)
+
+    for i in range(FEATURE_BLOCK):
+        b = bins_ref[i, :].astype(jnp.int32)                 # (T,)
+        key = jnp.where(valid, node * n_bins + b, -1)        # [0, m*B)
+        hi = key // lo_bins                                  # -1 drops out
+        lo = key - hi * lo_bins
+        hi_oh = (jax.lax.broadcasted_iota(jnp.int32, (n_hi, T), 0)
+                 == hi[None, :]).astype(jnp.bfloat16)        # (n_hi, T)
+        lo_oh = (jax.lax.broadcasted_iota(jnp.int32, (lo_bins, T), 0)
+                 == lo[None, :]).astype(jnp.bfloat16)        # (LO, T)
+        u = (w3[:, None, :] * hi_oh[None, :, :]).reshape(3 * n_hi, T)
+        res = jax.lax.dot_general(u, lo_oh, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        hg_ref[i] += res[:n_hi].reshape(n_hi, lo_bins)
+        hh_ref[i] += res[n_hi:2 * n_hi].reshape(n_hi, lo_bins)
+        hc_ref[i] += res[2 * n_hi:].reshape(n_hi, lo_bins)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("n_nodes", "n_bins", "interpret"))
 def pallas_hist(bins, grad, hess, node_local, active, n_nodes: int,
@@ -185,6 +268,8 @@ def pallas_hist(bins, grad, hess, node_local, active, n_nodes: int,
     c2 = cnt[None, :]
 
     factored = (n_bins >= FACTORED_MIN_BINS and n_nodes <= FACTORED_M_MAX)
+    joint = (n_bins >= JOINT_MIN_BINS
+             and FACTORED_M_MAX < n_nodes <= JOINT_M_MAX)
     row_spec = pl.BlockSpec((1, TILE_ROWS), lambda fb, t: (0, t))
     in_specs = [
         pl.BlockSpec((FEATURE_BLOCK, TILE_ROWS), lambda fb, t: (fb, t)),
@@ -192,6 +277,32 @@ def pallas_hist(bins, grad, hess, node_local, active, n_nodes: int,
     ]
     cparams = pltpu.CompilerParams(
         dimension_semantics=("parallel", "arbitrary"))
+    if joint:
+        # joint-key radix (see routing table above): pad the combined key
+        # span m*B up to a LO multiple; padded key columns are never hit
+        # (no row produces them) and are sliced off below
+        lo = _joint_lo(n_nodes)
+        key_span = n_nodes * n_bins
+        key_pad = key_span + ((-key_span) % lo)
+        n_hi = key_pad // lo
+        kernel = functools.partial(_hist_kernel_joint, m=n_nodes,
+                                   n_hi=n_hi, lo_bins=lo, n_bins=n_bins)
+        hg, hh, hc = pl.pallas_call(
+            kernel,
+            grid=(nFB, nT),
+            in_specs=in_specs,
+            out_specs=[pl.BlockSpec((FEATURE_BLOCK, n_hi, lo),
+                                    lambda fb, t: (fb, 0, 0))] * 3,
+            out_shape=[jax.ShapeDtypeStruct((F_pad, n_hi, lo),
+                                            jnp.float32)] * 3,
+            compiler_params=cparams,
+            interpret=interpret,
+        )(bins_t, node2, g2, h2, c2)
+        merge = lambda a: a.reshape(F_pad, key_pad)[:, :key_span].reshape(
+            F_pad, n_nodes, n_bins)
+        hg, hh, hc = merge(hg), merge(hh), merge(hc)
+        return (hg[:F].transpose(1, 0, 2), hh[:F].transpose(1, 0, 2),
+                hc[:F].transpose(1, 0, 2))
     if factored:
         # pad bins up to a LO_BINS multiple; padded bin columns stay zero
         # (no row carries them) and are sliced off below. Outputs are 4D

@@ -389,6 +389,29 @@ def test_predict_leaf_matches_gather_descent():
             assert fast[i, t] == node, (t, i)
 
 
+def test_host_device_raw_score_parity():
+    """raw_score's host numpy descent (the serving hot path — no device
+    dispatch per microbatch) must agree BITWISE with the jitted device
+    path on the same ensemble, including NaN routing and categorical
+    membership splits."""
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2000, 6)).astype(np.float32)
+    x[:, 4] = rng.integers(0, 12, size=2000)      # categorical column
+    x[::11, 2] = np.nan
+    y = ((x[:, 0] > 0) ^ (x[:, 4] % 3 == 0)).astype(np.float32)
+    booster, _, _ = fit_booster(
+        x, y, BoostParams(num_iterations=8, max_depth=5, min_data_in_leaf=5,
+                          categorical_features=(4,)))
+    host = booster.raw_score(x, backend="host")
+    dev = booster.raw_score(x, backend="device")
+    np.testing.assert_array_equal(host, dev)
+    # auto routes small batches to the host path and stays consistent
+    np.testing.assert_array_equal(booster.raw_score(x[:64]), host[:64])
+    with pytest.raises(ValueError, match="backend"):
+        booster.raw_score(x, backend="gpu")
+
+
 def test_deep_tree_predict_fallback():
     """max_depth beyond the select-chain limit routes through the gather
     descent and still scores correctly."""

@@ -8,7 +8,13 @@ from mmlspark_tpu.ops.histogram_pallas import pallas_hist
 
 
 @pytest.mark.parametrize("n,f,m,b", [(5000, 7, 4, 256), (3000, 16, 1, 64),
-                                     (2048, 8, 32, 256), (100, 3, 2, 64)])
+                                     (2048, 8, 32, 256), (100, 3, 2, 64),
+                                     # joint-key radix routes (m in (1,16],
+                                     # b >= 128), incl. non-power-of-two
+                                     # bin counts (255) whose key span
+                                     # pads up to the LO multiple
+                                     (4000, 5, 8, 256), (3000, 6, 16, 255),
+                                     (2500, 4, 2, 128), (2000, 3, 4, 255)])
 def test_pallas_matches_xla(n, f, m, b):
     rng = np.random.default_rng(n)
     bins = jnp.asarray(rng.integers(0, b, size=(n, f)).astype(np.uint8))

@@ -297,6 +297,49 @@ def test_serving_concurrent_throughput():
         server.stop()
 
 
+def test_serving_model_in_the_loop():
+    """16 concurrent clients scoring through a REAL fitted GBDT booster
+    (round-4 verdict item 5: the throughput floor must hold with a model
+    in the loop, not an echo lambda). Floor sits under the contended
+    number so background load cannot flake the suite; the quiet-host
+    numbers live in BENCH_MODE=serving."""
+    from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5000, 8)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    model = GBDTClassifier(num_iterations=10, max_depth=4).fit(
+        Table({"features": x, "label": y}))
+
+    server, q = serve_pipeline(model, input_cols=["features"],
+                               mode="microbatch", max_batch=256)
+    host, port = server._httpd.server_address[:2]
+    body = json.dumps({"features": [0.5] * 8})
+
+    def check(status, payload):
+        assert status == 200, (status, payload[:80])
+        assert json.loads(payload)["prediction"] == 1.0
+
+    try:
+        res = run_load(host, port, body, n_clients=16, per_client=60,
+                       check=check)
+        assert not res.errors, res.errors[:3]
+        assert res.n_ok == 16 * 60
+        print(f"model-in-loop serving: {res.req_per_sec:.0f} req/s, "
+              f"p99 {res.p99_ms:.1f} ms")
+        assert res.req_per_sec > 2000, \
+            f"{res.req_per_sec:.0f} req/s with model in the loop"
+        # generous bound: one ~100ms scheduler stall with 16 in-flight
+        # clients pushes ~16 latencies over any tight p99 cutoff; the
+        # tight quiet-host p50/p99 live in BENCH_MODE=serving
+        assert res.p99_ms < 250, f"p99 {res.p99_ms:.1f}ms"
+    finally:
+        q.stop()
+        server.stop()
+
+
 def test_poison_row_isolated_from_batch():
     """One malformed request inside a batch must 502 ALONE after bounded
     replay — its batch-mates still answer 200 (reference: ServingUDFs

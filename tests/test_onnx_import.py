@@ -135,6 +135,55 @@ def test_secondary_output_consumption_refused_at_load():
             onnx_import.load_onnx(b"ignored")
 
 
+def test_resnet18_onnx_parity_and_featurizer_cut():
+    """ResNet-class import proof (round-4 verdict item 6): a full
+    ResNet-18 graph — stem conv7x7/BN/ReLU/maxpool, 8 BasicBlocks with
+    identity and 1x1-projection residuals, GAP/Flatten/Gemm — exported
+    by torch's serializer, imported by the hand-rolled reader, parity
+    vs torch's own forward. Then the ImageFeaturizer layer-cut scores
+    the SAME bytes as a feature extractor (512-dim, the head dropped).
+    The ~45 MB graph is generated here (seeded weights), not committed.
+    64x64 inputs keep CPU CI fast; the op/graph structure is identical
+    to 224 (the bench imports at 224 on the real chip)."""
+    import tempfile
+    sys_path_add = os.path.join(os.path.dirname(__file__), "data")
+    import sys
+    if sys_path_add not in sys.path:
+        sys.path.insert(0, sys_path_add)
+    from torch_resnet import export_resnet18_onnx
+    from mmlspark_tpu.models.dnn.onnx_import import load_onnx
+
+    with tempfile.NamedTemporaryFile(suffix=".onnx", delete=False) as f:
+        path = f.name
+    try:
+        _, x, y_torch = export_resnet18_onnx(path, seed=0, spatial=64,
+                                             num_classes=10)
+        apply_fn, params = load_onnx(path)
+        import jax
+        y = np.asarray(jax.jit(apply_fn)(params, x))
+        rel = np.abs(y - y_torch).max() / (np.abs(y_torch).max() + 1e-9)
+        assert rel < 1e-4, rel
+
+        # layer cut: features = flattened GAP output, head dropped
+        feat_fn, fparams = load_onnx(path, cut="features")
+        feats = np.asarray(jax.jit(feat_fn)(fparams, x))
+        assert feats.shape == (2, 512), feats.shape
+
+        # ImageFeaturizer over the same bytes: NHWC images in,
+        # 512-dim features out, save/load round trip preserved
+        from mmlspark_tpu.models.dnn.image_featurizer import ImageFeaturizer
+        from mmlspark_tpu.core import Table
+        imgs = np.transpose(x, (0, 2, 3, 1))          # NHWC
+        fz = ImageFeaturizer(onnx_model=path, image_height=64,
+                             image_width=64, scale=1.0, dtype="float32")
+        out = fz.transform(Table({"image": imgs}))
+        got = np.asarray(out["features"])
+        assert got.shape == (2, 512)
+        np.testing.assert_allclose(got, feats, rtol=2e-3, atol=2e-3)
+    finally:
+        os.unlink(path)
+
+
 def test_wire_reader_roundtrip_basics():
     """Hand-assembled protobuf fragments decode as expected (varints,
     packed ints, fixed32 floats, nested messages)."""
