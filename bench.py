@@ -165,7 +165,9 @@ def _bench_gbdt_e2e():
         mapper = binning.fit_bins(x, max_bin=max_bin, seed=0)
         stages["fit_bins_s"] = round(time.time() - t0, 3)
         t0 = time.time()
-        bins_host = apply_bins_native(x, mapper.upper_bounds, mapper.n_bins)
+        # same call shape test_native_apply_bins_matches_python pins
+        bins_host = apply_bins_native(x, mapper.upper_bounds[:, :-1],
+                                      mapper.upper_bounds.shape[1])
         if bins_host is None:      # no compiler on host: numpy fallback
             bins_host = binning.apply_bins(mapper, x)
         stages["apply_bins_native_s"] = round(time.time() - t0, 3)
@@ -420,6 +422,66 @@ def _bench_resnet():
                       "vs_baseline": 0.0}))
 
 
+def _bench_resnet_onnx():
+    """Foreign-model inference imgs/sec/chip (round-4 verdict item 6): a
+    ResNet-18 graph EXPORTED BY TORCH, imported through the hand-rolled
+    ONNX reader (models/dnn/onnx_import.py), cast bf16, batch-128
+    inference at 224x224 — the ImageFeaturizer foreign-model path's
+    throughput (reference scores downloaded CNTK graphs the same way,
+    ImageFeaturizer.scala:40-215). Parity vs torch asserted at f32
+    before timing."""
+    import sys as _sys
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests", "data"))
+    from torch_resnet import export_resnet18_onnx
+    from mmlspark_tpu.models.dnn.onnx_import import load_onnx
+
+    with tempfile.NamedTemporaryFile(suffix=".onnx", delete=False) as f:
+        path = f.name
+    try:
+        _, x_np, y_torch = export_resnet18_onnx(path, seed=0, spatial=224)
+        apply_fn, params = load_onnx(path)
+    finally:
+        os.unlink(path)
+    # parity at HIGHEST precision: TPU's default f32 matmul/conv path
+    # multiplies in bf16 (~3e-3 rel), which is the right speed choice for
+    # the throughput row below but not for a correctness gate
+    with jax.default_matmul_precision("highest"):
+        y = np.asarray(jax.jit(apply_fn)(params, x_np))
+    rel = float(np.abs(y - y_torch).max()
+                / (np.abs(y_torch).max() + 1e-9))
+    assert rel < 1e-4, rel
+
+    batch = 128
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(batch, 3, 224, 224)), jnp.bfloat16)
+    p16 = {k: jnp.asarray(v, jnp.bfloat16)
+           if v.dtype == np.float32 else jnp.asarray(v)
+           for k, v in params.items()}
+
+    @jax.jit
+    def reps(x):
+        def body(c, i):
+            out = apply_fn(p16, x * (1 + i * 1e-6))
+            return c + out.astype(jnp.float32).sum(), None
+        s_, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(10))
+        return s_
+    float(reps(x))
+    t0 = time.time()
+    float(reps(x))
+    dt = (time.time() - t0) / 10
+    print(json.dumps({
+        "metric": "resnet18_onnx_import_bf16_imgs_per_sec",
+        "value": round(batch / dt, 1), "unit": "imgs/s",
+        "vs_baseline": 0.0, "parity_rel_err_f32": rel,
+        "note": "torch-exported ONNX -> hand-rolled importer -> jit; "
+                "north-star config[1] tracks imgs/sec/chip for the "
+                "foreign-model featurizer path"}))
+
+
 def _bench_lm_long_context():
     """16k-context causal LM training step (README long-context row's
     source): a ~220M-param GPT-2-medium-class model (12L, d=1024, 8 heads
@@ -518,6 +580,8 @@ def main():
         return _bench_flash()
     if mode == "resnet":
         return _bench_resnet()
+    if mode == "resnet_onnx":
+        return _bench_resnet_onnx()
     if mode == "lm":
         return _bench_lm_long_context()
     if mode == "gbdt_e2e":
