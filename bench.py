@@ -516,11 +516,13 @@ def _bench_lm_long_context():
                          (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS))
     else:
         mesh = grid_mesh((1, 1), (DATA_AXIS, PIPE_AXIS))
+    remat = os.environ.get("BENCH_LM_REMAT", "save_attn")
     t = PipelinedLMTrainer(
         vocab_size=V, mesh=mesh,
         n_microbatches=1, d_model=D, n_heads=H, n_layers=L, d_ff=FF,
         max_len=S, attention="flash", seed=0,
-        compute_dtype="bfloat16", remat=True)
+        compute_dtype="bfloat16",
+        remat=remat if remat in ("full", "save_attn") else True)
     n_params = sum(int(np.prod(a.shape))
                    for a in jax.tree_util.tree_leaves(t.params))
     toks = np.random.default_rng(0).integers(

@@ -294,6 +294,16 @@ def test_remat_is_loss_invariant():
     want = [base.step(toks) for _ in range(3)]
     got = [rm.step(toks) for _ in range(3)]
     assert got == pytest.approx(want, abs=1e-4)
+    # selective remat (FF-only checkpoint, attention residuals stored)
+    # is the same math again — must track the same trajectory
+    sa = PipelinedLMTrainer(
+        mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+        n_microbatches=4, remat="save_attn", **_KW)
+    got_sa = [sa.step(toks) for _ in range(3)]
+    assert got_sa == pytest.approx(want, abs=1e-4)
+    with pytest.raises(ValueError, match="remat"):
+        PipelinedLMTrainer(mesh=grid_mesh((2, 4), (DATA_AXIS, PIPE_AXIS)),
+                           remat="everything", **_KW)
 
 
 def test_bf16_remat_flash_composition():
