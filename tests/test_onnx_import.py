@@ -171,15 +171,25 @@ def test_resnet18_onnx_parity_and_featurizer_cut():
 
         # ImageFeaturizer over the same bytes: NHWC images in,
         # 512-dim features out, save/load round trip preserved
+        import tempfile as _tf
         from mmlspark_tpu.models.dnn.image_featurizer import ImageFeaturizer
         from mmlspark_tpu.core import Table
         imgs = np.transpose(x, (0, 2, 3, 1))          # NHWC
         fz = ImageFeaturizer(onnx_model=path, image_height=64,
                              image_width=64, scale=1.0, dtype="float32")
-        out = fz.transform(Table({"image": imgs}))
-        got = np.asarray(out["features"])
+        t_in = Table({"image": imgs})
+        got = np.asarray(fz.transform(t_in)["features"])
         assert got.shape == (2, 512)
         np.testing.assert_allclose(got, feats, rtol=2e-3, atol=2e-3)
+        # save/load: the state carries the ONNX bytes, NOT a second copy
+        # of the weights (they are reconstructible from the bytes)
+        state = fz._get_state()
+        assert "onnx_bytes" in state and "n_leaves" not in state
+        with _tf.TemporaryDirectory() as d:
+            fz.save(os.path.join(d, "fz"))
+            fz2 = ImageFeaturizer.load(os.path.join(d, "fz"))
+            got2 = np.asarray(fz2.transform(t_in)["features"])
+        np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-5)
     finally:
         os.unlink(path)
 
