@@ -16,16 +16,20 @@ shape. Training: `flash_attention`'s custom VJP is a FLASH BACKWARD — two
 Pallas kernels (dq over a (h, qb, kb) grid; dk/dv over (h, kb, qb))
 recompute each P block from q/k and the forward's saved log-sum-exp, so
 backward memory stays O(block) like the forward. Measured on v5e at 16k
-causal (BENCH_MODE=flash, 25-rep in-graph timing): bf16 forward 8.5 ms =
-4.9x dense XLA (32 TFLOP/s, 16% of chip bf16 peak — the D=64 head dim
-caps the MXU at half its array, so ~98 TFLOP/s is the shape's ceiling);
-fwd+bwd 21 ms where the dense backward needs 17+ GB of score gradients
-and OOMs. Perf notes: per-grid-cell overhead dominates below 1024-wide
-blocks (see _auto_blocks); interior blocks skip all mask work; matmuls
-run in the input dtype. `flash_attention_stats`' VJP is ALSO flash (the
-same two kernels with lse := m and dsum := -dl — see _flash_stats_bwd's
-shift-invariance derivation), so context-parallel ring training is
-O(block) memory in both directions.
+causal (BENCH_MODE=flash, 25-rep in-graph timing, round 5): bf16 forward
+d=64 8.2 ms = 5.0x dense XLA (33.5 TFLOP/s — the D=64 head dim caps the
+MXU at half its array, ~98 TFLOP/s shape ceiling); d=128 8.3 ms =
+66.2 TFLOP/s = 33.6% of chip bf16 peak (same wall time, twice the FLOPs —
+the 128-lane contraction fully fed); fwd+bwd 20.9 ms either dim (92
+TFLOP/s combined at d128) where the dense backward needs 17+ GB of score
+gradients and OOMs. Perf notes: per-grid-cell overhead dominates below
+1024-wide blocks (see _auto_blocks); 1024x1024 is also the d128 optimum
+(2048-wide blocks fail VMEM compile at d128; 1024x2048 measured 8.39 ms
+— no win); interior blocks skip all mask work; matmuls run in the input
+dtype. `flash_attention_stats`' VJP is ALSO flash (the same two kernels
+with lse := m and dsum := -dl — see _flash_stats_bwd's shift-invariance
+derivation), so context-parallel ring training is O(block) memory in
+both directions.
 """
 from __future__ import annotations
 
