@@ -517,12 +517,16 @@ def _bench_lm_long_context():
     else:
         mesh = grid_mesh((1, 1), (DATA_AXIS, PIPE_AXIS))
     remat = os.environ.get("BENCH_LM_REMAT", "save_attn")
+    if remat not in ("full", "save_attn"):
+        # silent coercion would attribute the wrong mode's numbers to
+        # the requested one — the record must say what actually ran
+        raise SystemExit(f"BENCH_LM_REMAT must be full|save_attn, "
+                         f"got {remat!r}")
     t = PipelinedLMTrainer(
         vocab_size=V, mesh=mesh,
         n_microbatches=1, d_model=D, n_heads=H, n_layers=L, d_ff=FF,
         max_len=S, attention="flash", seed=0,
-        compute_dtype="bfloat16",
-        remat=remat if remat in ("full", "save_attn") else True)
+        compute_dtype="bfloat16", remat=remat)
     n_params = sum(int(np.prod(a.shape))
                    for a in jax.tree_util.tree_leaves(t.params))
     toks = np.random.default_rng(0).integers(
@@ -558,7 +562,9 @@ def _bench_lm_long_context():
         "mfu_vs_bf16_peak": round(mfu, 4),
         "loss_step1": round(float(l1), 3), "loss_last": round(float(l2), 3),
         "mesh": mesh_kind,
-        "model": f"{L}L d={D} {H}h ff={FF} V={V} bf16+remat+flash"}))
+        "remat": remat,
+        "model": f"{L}L d={D} {H}h ff={FF} V={V} bf16+remat[{remat}]"
+                 f"+flash"}))
 
 
 def main():

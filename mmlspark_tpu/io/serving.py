@@ -239,8 +239,8 @@ class _SelectorServer:
         if not data:
             self._close(conn)
             return
-        if conn.reject is not None:
-            return  # desynced stream: ignore further bytes until close
+        if conn.reject is not None or conn.closing:
+            return  # desynced/closing stream: ignore bytes until close
         conn.rbuf += data
         self._parse(conn)
 
@@ -257,6 +257,7 @@ class _SelectorServer:
                 f"Content-Type: application/json\r\n"
                 f"Content-Length: {len(payload)}\r\n"
                 f"Connection: close\r\n\r\n").encode("latin-1") + payload
+        conn.rbuf = b""   # the stream is desynced: never re-parse it
         if not conn.inflight and not conn.wbuf:
             try:
                 conn.sock.send(resp)
