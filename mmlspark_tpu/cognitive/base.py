@@ -64,6 +64,11 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
     timeout = Param("timeout", "per-request timeout (s)", 60.0)
     retry_times = Param("retry_times", "advanced-handler retries", 3)
     backoff = Param("backoff", "advanced-handler initial backoff (s)", 0.05)
+    deadline = Param("deadline", "overall per-request retry budget (s)", None)
+    retry_policy = Param("retry_policy",
+                         "reliability.RetryPolicy overriding retry knobs "
+                         "(shared budgets across services)", None,
+                         transient=True)
 
     # statuses whose payload carries per-row results; services with
     # partial-failure responses widen this (Azure Search 207 Multi-Status)
@@ -102,11 +107,17 @@ class CognitiveServiceBase(Transformer, HasOutputCol, HasServiceParams):
         # requests may be batched: fewer requests than rows (TextAnalytics
         # sends up to batch_size documents per call, TextAnalytics.scala)
         rt = Table({req_col: reqs_arr})
+        # retry knobs pass straight through to HTTPTransformer, which owns
+        # the one params->RetryPolicy construction site — the same loop
+        # shape as utils.retry / advanced_handler, not a fourth divergent
+        # retry implementation
         client = HTTPTransformer(
             input_col=req_col, output_col=resp_col,
             concurrency=self.concurrency, handler="advanced",
             timeout=self.timeout, retry_times=self.retry_times,
-            backoff=self.backoff)
+            backoff=self.backoff, deadline=self.deadline,
+            retry_policy=self.retry_policy,
+            retry_metric_name="cognitive.retries")
         responses = client.transform(rt)[resp_col]
         outputs, errors = self._route(responses, spans, len(t))
         out_arr = np.empty(len(t), dtype=object)

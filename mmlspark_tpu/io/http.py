@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import time
 import urllib.error
 import urllib.request
 from typing import Callable, Optional
@@ -32,6 +31,7 @@ import numpy as np
 
 from ..core import Param, Table, Transformer, HasInputCol, HasOutputCol
 from ..core.params import in_range, one_of
+from ..reliability.policy import RetryPolicy
 from ..utils.async_utils import bounded_map
 
 
@@ -91,36 +91,48 @@ def basic_handler(req: HTTPRequest, timeout: float = 60.0) -> HTTPResponse:
     return _send_once(req, timeout)
 
 
+_CONNECTION_ERRORS = (urllib.error.URLError, ConnectionError, TimeoutError,
+                      OSError)
+
+
 def advanced_handler(req: HTTPRequest, timeout: float = 60.0,
-                     retry_times: int = 3, backoff: float = 0.1) -> HTTPResponse:
+                     retry_times: int = 3, backoff: float = 0.1,
+                     policy: Optional[RetryPolicy] = None) -> HTTPResponse:
     """reference: HandlingUtils.advanced (HTTPClients.scala:65-156): retry
-    connection failures and 429s with exponential backoff; 429 honors a
-    Retry-After header when present."""
-    delay = backoff
+    connection failures and 429s with jittered exponential backoff; 429
+    honors a Retry-After header when present. The loop shape (backoff,
+    jitter, overall deadline, budget) comes from `policy` — the same
+    RetryPolicy the rest of the framework retries with; `retry_times` /
+    `backoff` build a default one."""
+    if policy is None:
+        policy = RetryPolicy(max_attempts=retry_times, backoff=backoff,
+                             metric_name="http.retries")
     last_err = None
-    for attempt in range(retry_times):
+    resp: Optional[HTTPResponse] = None
+    for attempt in policy.attempts():
         try:
-            resp = _send_once(req, timeout)
-        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
-            last_err = e
-            if attempt + 1 == retry_times:
-                return HTTPResponse(status=0, reason="connection failed",
-                                    error=f"{type(e).__name__}: {e}")
-            time.sleep(delay)
-            delay *= 2
+            resp = _send_once(req, attempt.timeout(timeout))
+        except _CONNECTION_ERRORS as e:
+            last_err, resp = e, None
+            attempt.retry()
             continue
-        if resp.status == 429 and attempt + 1 < retry_times:
+        if resp.status == 429 and not attempt.is_last:
             retry_after = (resp.headers or {}).get("Retry-After")
             try:
-                wait = float(retry_after) if retry_after else delay
+                wait = float(retry_after) if retry_after else None
             except ValueError:
-                wait = delay
-            time.sleep(wait)
-            delay *= 2
+                wait = None
+            attempt.retry(delay=wait)
             continue
+        if policy.budget is not None:
+            policy.budget.on_success()
         return resp
-    return HTTPResponse(status=0, reason="retries exhausted",
-                        error=str(last_err) if last_err else None)
+    if resp is not None:
+        return resp  # retries exhausted on a throttled (429) response
+    if last_err is not None:
+        return HTTPResponse(status=0, reason="connection failed",
+                            error=f"{type(last_err).__name__}: {last_err}")
+    return HTTPResponse(status=0, reason="retries exhausted")
 
 
 class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
@@ -138,14 +150,30 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                            "`handler`", None, transient=True)
     retry_times = Param("retry_times", "advanced handler retries", 3)
     backoff = Param("backoff", "advanced handler initial backoff (s)", 0.1)
+    deadline = Param("deadline", "overall per-request retry budget (s); "
+                     "attempts+sleeps never exceed it", None)
+    retry_policy = Param("retry_policy",
+                         "reliability.RetryPolicy overriding retry_times/"
+                         "backoff/deadline (shared budgets, custom jitter)",
+                         None, transient=True)
+    retry_metric_name = Param("retry_metric_name",
+                              "reliability counter retries land under",
+                              "http.retries")
+
+    def _build_policy(self) -> RetryPolicy:
+        if self.retry_policy is not None:
+            return self.retry_policy
+        return RetryPolicy(max_attempts=self.retry_times, backoff=self.backoff,
+                           deadline=self.deadline,
+                           metric_name=self.retry_metric_name)
 
     def _handler_fn(self) -> Callable[[HTTPRequest], HTTPResponse]:
         if self.custom_handler is not None:
             return self.custom_handler
         if self.handler == "basic":
             return lambda r: basic_handler(r, self.timeout)
-        return lambda r: advanced_handler(r, self.timeout, self.retry_times,
-                                          self.backoff)
+        policy = self._build_policy()
+        return lambda r: advanced_handler(r, self.timeout, policy=policy)
 
     def _transform(self, t: Table) -> Table:
         fn = self._handler_fn()

@@ -43,6 +43,8 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..core import Table
+from ..reliability.faults import FaultInjector, InjectedCrash
+from ..reliability.metrics import reliability_metrics
 
 
 class CachedRequest:
@@ -119,7 +121,7 @@ class _ThreadingServer(ThreadingHTTPServer):
 
 _REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
             501: "Not Implemented", 502: "Bad Gateway",
-            504: "Gateway Timeout"}
+            503: "Service Unavailable", 504: "Gateway Timeout"}
 
 # Ingress bounds: a header block or body beyond these is rejected and the
 # connection closed — the single-threaded loop must never be wedged (or its
@@ -166,6 +168,7 @@ class _SelectorServer:
         self._wake_w.setblocking(False)
         self._ready = collections.deque()
         self._stop = threading.Event()
+        self._refuse_new = False   # drain: accept() then immediately close
         self._sel.register(self._lsock, 1, ("accept", None))   # EVENT_READ
         self._sel.register(self._wake_r, 1, ("wake", None))
         self._deadlines: dict = {}
@@ -207,6 +210,41 @@ class _SelectorServer:
                     except Exception:  # noqa: BLE001
                         self._close(conn)
             self._expire()
+        # final drain: responses routed in just before shutdown() must still
+        # reach their sockets (stop()'s drain contract: answered AND flushed)
+        while self._ready:
+            conn = self._ready.popleft()
+            if not conn.closed:
+                try:
+                    self._flush(conn)
+                except Exception:  # noqa: BLE001
+                    self._close(conn)
+
+    def stop_accepting(self):
+        """Graceful-drain step 1: refuse NEW connections while held ones
+        keep being answered. Flag-based — only the loop thread touches the
+        selector, so this is safe to call from any thread."""
+        self._refuse_new = True
+
+    def pending_exchanges(self) -> bool:
+        """Any unanswered in-flight request or undrained write buffer?
+        Best-effort read from the drain thread; the loop owns the maps."""
+        try:
+            if self._ready:
+                return True  # answered responses not yet serialized
+            for _rid, (_, req) in list(self._deadlines.items()):
+                if not req._event.is_set():
+                    return True
+            for key in list(self._sel.get_map().values()):
+                kind, conn = key.data
+                # ANY inflight exchange counts: an answered request leaves
+                # conn.inflight only when its response reaches wbuf, so a
+                # respond() racing the loop's _ready drain is still seen
+                if kind == "conn" and (conn.wbuf or conn.inflight):
+                    return True
+        except (RuntimeError, KeyError):  # map mutated under us: stay safe
+            return True
+        return False
 
     def _accept(self):
         while True:
@@ -214,6 +252,12 @@ class _SelectorServer:
                 sock, _ = self._lsock.accept()
             except (BlockingIOError, OSError):
                 return
+            if self._refuse_new:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             sock.setblocking(False)
             try:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -306,6 +350,15 @@ class _SelectorServer:
                 return
             body = conn.rbuf[head_end + 4:total]
             conn.rbuf = conn.rbuf[total:]
+            inj = self.serving._faults
+            if inj is not None:
+                fault = inj.fire("serving.ingress")
+                if fault is not None and fault.kind == "reset":
+                    # injected connection reset: drop the socket mid-exchange
+                    # — the client's retry layer, not this request, must
+                    # recover (nothing was enqueued)
+                    self._close(conn)
+                    return
             req = CachedRequest(body, headers, path,
                                 on_respond=None)
             req._on_respond = (lambda c=conn: self._notify(c))
@@ -413,11 +466,20 @@ class ServingServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  num_partitions: int = 1, reply_timeout: float = 30.0,
-                 transport: str = "selector"):
+                 transport: str = "selector", max_queue: int = 1024,
+                 faults: Optional[FaultInjector] = None):
         if transport not in ("selector", "threading"):
             raise ValueError("transport must be selector|threading")
         self.num_partitions = num_partitions
         self.reply_timeout = reply_timeout
+        # load shedding bound: a partition queue beyond this answers 503
+        # immediately instead of growing without bound (heavy-traffic
+        # ingress must fail fast, not queue into certain 504s)
+        self.max_queue = max_queue
+        # deterministic fault injection (None = zero-overhead disabled);
+        # falls back to the MMLSPARK_TPU_FAULTS env spec
+        self._faults = faults if faults is not None else FaultInjector.from_env()
+        self._draining = False
         self._queues = [queue.Queue() for _ in range(num_partitions)]
         self._rr = itertools.count()
         # (partition, epoch) -> list[CachedRequest]; GC'd on commit
@@ -438,7 +500,28 @@ class ServingServer:
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, drain: bool = True, drain_timeout: float = 5.0):
+        """Graceful drain then shutdown: new connections are refused and
+        new requests answered 503, in-flight exchanges are answered and
+        flushed (bounded by `drain_timeout`), THEN the transport dies.
+        `drain=False` is the old hard stop."""
+        self._draining = True
+        if drain:
+            stop_accepting = getattr(self._httpd, "stop_accepting", None)
+            if stop_accepting is not None:
+                stop_accepting()
+            pending = getattr(self._httpd, "pending_exchanges", None)
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline:
+                if pending is not None:
+                    busy = pending()
+                else:
+                    with self._lock:
+                        busy = any(not r._event.is_set()
+                                   for r in self._routing.values())
+                if not busy:
+                    break
+                time.sleep(0.01)
         self._httpd.shutdown()
         # join the loop thread BEFORE closing fds: the selector loop may
         # be inside select()/recv(), and closing the epoll fd under it
@@ -455,7 +538,19 @@ class ServingServer:
 
     # -- ingress ------------------------------------------------------------
     def _enqueue(self, req: CachedRequest):
+        if self._draining:
+            # drain: in-flight work finishes, NEW work is refused
+            reliability_metrics.inc("serving.shed_requests")
+            req.respond(503, b'{"error": "server draining"}')
+            return
         pid = next(self._rr) % self.num_partitions
+        if self.max_queue and self._queues[pid].qsize() >= self.max_queue:
+            # load shedding: a queue past the bound means every enqueued
+            # request is already doomed to time out — shed NOW with 503 so
+            # clients back off instead of piling onto a 504 cliff
+            reliability_metrics.inc("serving.shed_requests")
+            req.respond(503, b'{"error": "overloaded"}')
+            return
         with self._lock:
             self._routing[req.id] = req
         self._queues[pid].put(req)
@@ -529,25 +624,50 @@ class ServingQuery:
 
     def __init__(self, server: ServingServer, transform_fn: Callable,
                  mode: str = "microbatch", max_batch: int = 64,
-                 poll_timeout: float = 0.02):
+                 poll_timeout: float = 0.02,
+                 faults: Optional[FaultInjector] = None,
+                 watchdog_interval: float = 0.02):
         if mode not in ("microbatch", "continuous"):
             raise ValueError("mode must be microbatch|continuous")
         self.server = server
         self.transform_fn = transform_fn
         self.max_batch = 1 if mode == "continuous" else max_batch
         self.poll_timeout = poll_timeout
+        self.watchdog_interval = watchdog_interval
+        # share the server's injector by default: one seed, one schedule
+        self._faults = faults if faults is not None else server._faults
         self._stop = threading.Event()
         self._threads: list = []
+        self._watchdog: Optional[threading.Thread] = None
         self._errors: list = []
         self._inject: set = set()  # partitions poisoned by inject_fault
         self._recoveries = 0
+        self._restarts = 0
 
     def start(self) -> "ServingQuery":
         for pid in range(self.server.num_partitions):
             th = threading.Thread(target=self._work, args=(pid,), daemon=True)
             th.start()
             self._threads.append(th)
+        # watchdog: a worker thread that DIES (an InjectedCrash, a segfaulted
+        # extension, an unforeseen escape) is restarted; the uncommitted
+        # epoch replays to the fresh worker (reference: registerPartition
+        # recovery, HTTPSourceV2.scala:488-505)
+        self._watchdog = threading.Thread(target=self._watch, daemon=True)
+        self._watchdog.start()
         return self
+
+    def _watch(self):
+        while not self._stop.wait(self.watchdog_interval):
+            for pid, th in enumerate(self._threads):
+                if th.is_alive() or self._stop.is_set():
+                    continue
+                self._restarts += 1
+                reliability_metrics.inc("serving.worker_restarts")
+                fresh = threading.Thread(target=self._work, args=(pid,),
+                                         daemon=True)
+                self._threads[pid] = fresh
+                fresh.start()
 
     MAX_REPLAYS = 3  # per epoch; then the batch is failed out (502) and
     # committed so one poison request can't wedge its partition forever
@@ -565,17 +685,33 @@ class ServingQuery:
                     # attempt (reference: HTTPv2Suite "fault tolerance" :329).
                     self._inject.discard(pid)
                     raise RuntimeError("injected worker death")
+                if self._faults is not None and batch:
+                    # seeded faults at the same worst spot; only non-empty
+                    # reads advance the site counter so the schedule is
+                    # deterministic for a serialized request stream
+                    self._faults.perturb("serving.worker")
                 if not batch:
                     self.server.commit(epoch, pid)
                     continue
                 self._process(pid, epoch, batch)
                 self.server.commit(epoch, pid)
                 replays = 0
+            except InjectedCrash:
+                # injected worker DEATH: the thread exits with the epoch
+                # uncommitted — the watchdog restarts it and history replays
+                # the in-flight batch to the fresh worker. (return, not
+                # raise: an intentional death shouldn't spray a traceback)
+                self._recoveries += 1
+                if batch:
+                    reliability_metrics.inc("serving.replayed_epochs")
+                return
             except Exception as e:  # noqa: BLE001 - worker survives task errors
                 if len(self._errors) < 1000:
                     self._errors.append(e)
                 self._recoveries += 1
                 replays += 1
+                if batch:
+                    reliability_metrics.inc("serving.replayed_epochs")
                 if batch and replays > self.MAX_REPLAYS:
                     # poison batch: isolate the poison ROW instead of
                     # failing everyone — retry each request individually so
@@ -611,6 +747,8 @@ class ServingQuery:
 
     def stop(self):
         self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
         for th in self._threads:
             th.join(timeout=5)
 

@@ -42,6 +42,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams/TPUMemorySpace to CompilerParams/MemorySpace;
+# resolve whichever this jax ships so both sides of the rename run
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+_MemorySpace = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
 BLOCK_Q = 256
 BLOCK_K = 256
 # Measured on v5e (16k causal, H=8 D=64, 25-rep in-graph timing): the
@@ -196,7 +202,7 @@ _COMPILER_PARAMS = None
 def _compiler_params():
     global _COMPILER_PARAMS
     if _COMPILER_PARAMS is None:
-        _COMPILER_PARAMS = pltpu.CompilerParams(
+        _COMPILER_PARAMS = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
     return _COMPILER_PARAMS
 
@@ -368,8 +374,8 @@ def _flash_stats_forward(q, k, v, q_offset, k_offset, causal, scale,
         kernel,
         grid=(h, n_q, n_k),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=_MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=_MemorySpace.SMEM),
             pl.BlockSpec((1, block_q, d), lambda hh, qb, kb: (hh, qb, 0)),
             pl.BlockSpec((1, block_k, d), lambda hh, qb, kb: (hh, kb, 0)),
             pl.BlockSpec((1, block_k, d), lambda hh, qb, kb: (hh, kb, 0)),
@@ -607,7 +613,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                     constant_values=jnp.inf) if pad_q else lse
     qoff_arr = jnp.asarray(q_offset, jnp.int32).reshape(1)
     koff_arr = jnp.asarray(k_offset, jnp.int32).reshape(1)
-    smem = pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM)
+    smem = pl.BlockSpec(memory_space=_MemorySpace.SMEM)
 
     row_spec_q = pl.BlockSpec((1, block_q, d), lambda hh, qb, kb: (hh, qb, 0))
     col_spec_k = pl.BlockSpec((1, block_k, d), lambda hh, qb, kb: (hh, kb, 0))

@@ -35,6 +35,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; run on both sides
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 # tile sweep on v5e (1M-4M rows x 32 features x 64 bins): 8192/32 is ~5%
 # faster than 4096/16; the VMEM worst case (m = M_MAX = 64 nodes with 256
 # bins: 3x(32,64,256) f32 outputs + (256,8192) bf16 bin one-hot +
@@ -275,7 +279,7 @@ def pallas_hist(bins, grad, hess, node_local, active, n_nodes: int,
         pl.BlockSpec((FEATURE_BLOCK, TILE_ROWS), lambda fb, t: (fb, t)),
         row_spec, row_spec, row_spec, row_spec,
     ]
-    cparams = pltpu.CompilerParams(
+    cparams = _CompilerParams(
         dimension_semantics=("parallel", "arbitrary"))
     if joint:
         # joint-key radix (see routing table above): pad the combined key

@@ -26,6 +26,9 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from ..reliability.metrics import reliability_metrics
+from ..reliability.policy import RetryPolicy
+
 
 class ClusterInfo(NamedTuple):
     """This process's coordinates in the job (reference analog: partition id
@@ -38,7 +41,9 @@ class ClusterInfo(NamedTuple):
 
 def initialize_cluster(coordinator_address: Optional[str] = None,
                        num_processes: Optional[int] = None,
-                       process_id: Optional[int] = None) -> ClusterInfo:
+                       process_id: Optional[int] = None,
+                       retry_policy: Optional[RetryPolicy] = None
+                       ) -> ClusterInfo:
     """Join (or start) the jax.distributed job and report coordinates.
 
     On TPU pods all three arguments auto-detect from the metadata server; on
@@ -47,6 +52,12 @@ def initialize_cluster(coordinator_address: Optional[str] = None,
     LightGBMUtils.scala:119-188 — here the coordinator does it for us).
     Idempotent: calling on an already-initialized or single-process job is a
     no-op, so library code can call it unconditionally.
+
+    `retry_policy` retries a FAILED rendezvous (the reference's
+    FaultToleranceUtils.retryWithTimeout around LightGBM network init,
+    TrainUtils.scala:662 — workers race the coordinator coming up); the
+    default stays one strict attempt so misconfiguration surfaces
+    immediately. Retries are counted under `cluster.rendezvous_retries`.
     """
     # Decide multi-process from the ARGUMENTS/ENV alone — probing
     # jax.process_count() first would initialize the XLA backend, after
@@ -57,16 +68,36 @@ def initialize_cluster(coordinator_address: Optional[str] = None,
              or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
     import jax
     if multi:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes, process_id=process_id)
-        except RuntimeError as e:
-            # idempotence only: a second call in the same process is fine;
-            # anything else (backend already up, rendezvous failure) must
-            # surface — a silent fallback would run N disconnected jobs
-            if "already initialized" not in str(e).lower():
+        def _join():
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id)
+            except RuntimeError as e:
+                # idempotence only: a second call in the same process is
+                # fine; anything else (backend already up, rendezvous
+                # failure) must surface — a silent fallback would run N
+                # disconnected jobs
+                if "already initialized" in str(e).lower():
+                    return
+                # a FAILED initialize can leave the distributed client
+                # half-assigned (jax sets global state before connect), and
+                # a retry would then hit "should only be called once"
+                # instead of re-attempting the rendezvous — reset first so
+                # retry_policy attempts genuinely rejoin
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # noqa: BLE001 - best-effort state reset
+                    pass
                 raise
+
+        if retry_policy is not None:
+            retry_policy.call(
+                _join, retry_on=(RuntimeError,),
+                on_retry=lambda att, e: reliability_metrics.inc(
+                    "cluster.rendezvous_retries"))
+        else:
+            _join()
     return ClusterInfo(process_id=jax.process_index(),
                        process_count=jax.process_count(),
                        local_device_count=jax.local_device_count(),

@@ -1,0 +1,15 @@
+"""Unified resilience layer: retry/backoff/deadline policies, circuit
+breaking, recovery metrics, and a deterministic fault-injection harness
+(reference analog: FaultToleranceUtils + the scenario-level fault tests of
+HTTPv2Suite, unified and made seed-reproducible). See docs/reliability.md."""
+from .faults import (FAULTS_ENV, Fault, FaultInjector, InjectedCrash,
+                     InjectedFault)
+from .metrics import Counter, MetricsRegistry, reliability_metrics
+from .policy import (Attempt, CircuitBreaker, CircuitOpenError, Deadline,
+                     RetryBudget, RetryPolicy)
+
+__all__ = ["RetryPolicy", "RetryBudget", "Attempt", "CircuitBreaker",
+           "CircuitOpenError", "Deadline",
+           "FaultInjector", "Fault", "InjectedFault", "InjectedCrash",
+           "FAULTS_ENV",
+           "MetricsRegistry", "Counter", "reliability_metrics"]

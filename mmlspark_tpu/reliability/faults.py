@@ -1,0 +1,197 @@
+"""Deterministic, seedable fault injection.
+
+The reference proves its recovery paths with scenario tests (HTTPv2Suite
+fault tolerance :329, flaky connection :401) but each scenario hand-rolls
+its own failure; nothing is reproducible from a seed. `FaultInjector` makes
+every injected failure — delays, connection resets, worker crashes,
+malformed payloads, checkpoint corruption — come from one seeded schedule,
+so a chaos test that fails prints a seed that replays the identical fault
+sequence.
+
+Design:
+- Injection *sites* are names ("serving.worker", "serving.ingress",
+  "fuzz.http", ...). Every `fire(site)` call increments a per-site counter;
+  rules match by site glob and fire either at fixed per-site call indices
+  (`"at": [2, 5]`) or with a seeded per-site probability (`"prob": 0.1`).
+- Per-site RNG streams are derived as `crc32(site) ^ seed` — NOT Python's
+  randomized `hash()` — so the schedule is stable across processes and
+  independent of the order other sites are exercised (thread-safe
+  determinism: concurrent sites never perturb each other's stream).
+- `history` records every fired fault as `(site, call_index, kind)`; two
+  runs with the same seed and the same per-site call sequences produce
+  identical histories — that equality IS the reproducibility assertion.
+- Zero overhead when disabled: production code holds `None` (the
+  `from_env()` default without the env var) and branches on `is not None`;
+  no injector object, no call, no lock.
+
+Activation: pass an injector explicitly, or export
+`MMLSPARK_TPU_FAULTS='{"seed": 7, "rules": [{"site": "serving.worker",
+"kind": "crash", "at": [1]}]}'` and every `FaultInjector.from_env()` site
+picks it up.
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Callable, NamedTuple, Optional
+
+FAULTS_ENV = "MMLSPARK_TPU_FAULTS"
+
+# Hard cap on injected delays: chaos suites must stay fast and the tier-1
+# run deterministic-ish under load (ISSUE: no sleeps > 0.2s).
+MAX_INJECTED_DELAY = 0.2
+
+
+class InjectedFault(Exception):
+    """A recoverable injected failure (retry/replay paths absorb it)."""
+
+
+class InjectedCrash(InjectedFault):
+    """An injected worker DEATH: escapes the worker's recovery catch so the
+    thread actually dies and the watchdog/replay machinery must engage."""
+
+
+class Fault(NamedTuple):
+    site: str
+    index: int          # per-site call index the fault fired at
+    kind: str           # crash | error | delay | reset | corrupt | ...
+    param: Optional[float] = None
+
+
+class FaultInjector:
+    """Seeded rule-driven fault source. See module docstring for the rule
+    shapes; unknown kinds are returned to the caller to interpret (serving
+    handles "reset", checkpoint tests handle "corrupt", ...)."""
+
+    def __init__(self, seed: int = 0, rules: Optional[list] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.seed = int(seed)
+        self.rules = list(rules or [])
+        for r in self.rules:
+            if "site" not in r or "kind" not in r:
+                raise ValueError(f"fault rule needs site+kind: {r!r}")
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+        self._rngs: dict = {}
+        self.history: list = []   # list[Fault], in fire order
+
+    @classmethod
+    def from_env(cls, var: str = FAULTS_ENV) -> Optional["FaultInjector"]:
+        """Build from the env var's JSON spec; None when unset (the
+        zero-overhead disabled state)."""
+        spec = os.environ.get(var)
+        if not spec:
+            return None
+        cfg = json.loads(spec)
+        return cls(seed=cfg.get("seed", 0), rules=cfg.get("rules", []))
+
+    # -- deterministic per-site randomness ------------------------------------
+    def _site_rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            # crc32, not hash(): stable across processes/PYTHONHASHSEED
+            rng = random.Random(zlib.crc32(site.encode()) ^ self.seed)
+            self._rngs[site] = rng
+        return rng
+
+    # -- core ------------------------------------------------------------------
+    def fire(self, site: str) -> Optional[Fault]:
+        """Advance the site's call counter and return the fault scheduled
+        for this call, if any. First matching rule wins."""
+        with self._lock:
+            index = self._counts.get(site, 0)
+            self._counts[site] = index + 1
+            for rule in self.rules:
+                if not fnmatch.fnmatchcase(site, rule["site"]):
+                    continue
+                at = rule.get("at")
+                if at is not None:
+                    if index not in at:
+                        continue
+                elif self._site_rng(site).random() >= rule.get("prob", 0.0):
+                    continue
+                fault = Fault(site, index, rule["kind"], rule.get("param"))
+                self.history.append(fault)
+                return fault
+        return None
+
+    def perturb(self, site: str) -> Optional[Fault]:
+        """fire() plus the generic kinds applied in place: "delay" sleeps
+        (capped), "error" raises InjectedFault, "crash" raises
+        InjectedCrash. Site-specific kinds are returned for the caller."""
+        fault = self.fire(site)
+        if fault is None:
+            return None
+        if fault.kind == "delay":
+            self._sleep(min(fault.param or 0.05, MAX_INJECTED_DELAY))
+            return fault
+        if fault.kind == "crash":
+            raise InjectedCrash(f"injected crash at {site}#{fault.index}")
+        if fault.kind == "error":
+            raise InjectedFault(f"injected error at {site}#{fault.index}")
+        return fault
+
+    def wrap(self, site: str, fn: Callable) -> Callable:
+        """Callable wrapper: perturb(site) before each call of fn."""
+        def wrapped(*args, **kwargs):
+            self.perturb(site)
+            return fn(*args, **kwargs)
+        return wrapped
+
+    # -- payload/file corruption ----------------------------------------------
+    CORRUPT_MODES = ("truncate", "flip", "garbage")
+
+    def corrupt_bytes(self, site: str, data: bytes) -> bytes:
+        """Deterministically mangle a payload (malformed/truncated bytes for
+        fuzzing): truncate at a seeded point, flip seeded bytes, or splice
+        seeded garbage. Unconditional — callers decide when; the mode and
+        positions come from the site's seeded stream."""
+        with self._lock:
+            index = self._counts.get(site, 0)
+            self._counts[site] = index + 1
+            rng = self._site_rng(site)
+            mode = rng.choice(self.CORRUPT_MODES)
+            self.history.append(Fault(site, index, f"corrupt:{mode}"))
+            if not data:
+                return data
+            if mode == "truncate":
+                return data[: rng.randrange(len(data))]
+            if mode == "flip":
+                out = bytearray(data)
+                for _ in range(max(1, len(out) // 16)):
+                    pos = rng.randrange(len(out))
+                    out[pos] ^= 1 + rng.randrange(255)
+                return bytes(out)
+            junk = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 9)))
+            pos = rng.randrange(len(data) + 1)
+            return data[:pos] + junk + data[pos:]
+
+    def corrupt_file(self, path: str, site: str = "checkpoint") -> None:
+        """Truncate a file to a seeded fraction of its size — the
+        checkpoint-corruption fault (a crash mid-write of a non-atomic
+        copy, a torn disk)."""
+        size = os.path.getsize(path)
+        with self._lock:
+            index = self._counts.get(site, 0)
+            self._counts[site] = index + 1
+            keep = self._site_rng(site).randrange(max(size, 1))
+            self.history.append(Fault(site, index, "corrupt:truncate-file",
+                                      float(keep)))
+        with open(path, "rb+") as f:
+            f.truncate(keep)
+
+    # -- introspection ---------------------------------------------------------
+    def schedule(self) -> list:
+        """(site, index, kind) triples of every fired fault — compare across
+        runs to assert seed-reproducibility."""
+        return [(f.site, f.index, f.kind) for f in self.history]
+
+    def __repr__(self):
+        return (f"FaultInjector(seed={self.seed}, rules={len(self.rules)}, "
+                f"fired={len(self.history)})")

@@ -1,0 +1,102 @@
+"""Recovery-counter registry: one process-wide place where every resilience
+path (retries, breaker trips, replayed epochs, shed requests, corrupt
+checkpoints skipped) records what it survived.
+
+Role analog: the reference surfaces recovery behavior only through logs; a
+production serving stack needs the counters queryable (ROADMAP north star:
+heavy traffic means recovery events are routine, not exceptional). The
+registry doubles as a `utils.tracing.wall_clock` sink — `registry.observe`
+has the `(label, seconds)` sink signature, so timed blocks land next to the
+counters they explain:
+
+    with tracing.wall_clock("replay", sink=reliability_metrics.observe):
+        ...
+    reliability_metrics.snapshot()
+    # {"replay.seconds": 0.013, "replay.count": 1, "serving.replayed_epochs": 1}
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Counter:
+    """Monotonic counter; thread-safe."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def __repr__(self):
+        return f"Counter({self.name}={self._value})"
+
+
+class MetricsRegistry:
+    """Named counters + wall-clock observations. All methods thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._timings: dict = {}   # label -> [total_seconds, count]
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def inc(self, name: str, n: int = 1) -> int:
+        return self.counter(name).inc(n)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            c = self._counters.get(name)
+        return c.value if c is not None else 0
+
+    # -- tracing sink --------------------------------------------------------
+    def observe(self, label: str, seconds: float) -> None:
+        """`utils.tracing.wall_clock(label, sink=registry.observe)`."""
+        with self._lock:
+            t = self._timings.setdefault(label, [0.0, 0])
+            t[0] += seconds
+            t[1] += 1
+
+    # -- read side -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {name: c.value for name, c in self._counters.items()}
+            for label, (total, count) in self._timings.items():
+                out[f"{label}.seconds"] = total
+                out[f"{label}.count"] = count
+        return out
+
+    def reset(self, prefix: Optional[str] = None) -> None:
+        """Zero counters/timings (tests isolate scenarios with this).
+        `prefix` limits the reset to one subsystem's names."""
+        with self._lock:
+            if prefix is None:
+                self._counters.clear()
+                self._timings.clear()
+                return
+            for name in [n for n in self._counters if n.startswith(prefix)]:
+                del self._counters[name]
+            for name in [n for n in self._timings if n.startswith(prefix)]:
+                del self._timings[name]
+
+
+# Process-wide default: library code records here unless handed a private
+# registry (mirrors how the stage registry / shared singletons work).
+reliability_metrics = MetricsRegistry()

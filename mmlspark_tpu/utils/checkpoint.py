@@ -13,11 +13,24 @@ any model that can serialize to arrays/strings can checkpoint through this.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import tempfile
+import zipfile
 
 import numpy as np
+
+from ..reliability.metrics import reliability_metrics
+
+logger = logging.getLogger(__name__)
+
+# everything a truncated/corrupt payload.npz or meta.json can raise out of
+# np.load/json.load: torn zip central directory (BadZipFile), short reads
+# (EOFError/OSError), garbage JSON (ValueError covers JSONDecodeError),
+# missing member (KeyError)
+_CORRUPT_ERRORS = (OSError, ValueError, KeyError, EOFError,
+                   zipfile.BadZipFile)
 
 
 class CheckpointManager:
@@ -82,11 +95,34 @@ class CheckpointManager:
             shutil.rmtree(self._step_dir(old), ignore_errors=True)
 
     def restore(self, step: int = None) -> dict:
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        """Load a step's payload. With `step=None` (latest), a step whose
+        payload.npz/meta.json is truncated or corrupt is SKIPPED — restore
+        falls back to the next-newest retained step (logged + counted in
+        reliability metrics) instead of raising; a torn disk or killed
+        copy must cost one checkpoint interval, not the whole run. An
+        explicitly requested step still raises on corruption."""
+        if step is not None:
+            return self._load_step(step)
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory!r}")
+        last_err: Exception = FileNotFoundError(self.directory)
+        for s in reversed(steps):
+            try:
+                return self._load_step(s)
+            except _CORRUPT_ERRORS as e:
+                last_err = e
+                reliability_metrics.inc("checkpoint.corrupt_skipped")
+                logger.warning(
+                    "checkpoint step %d under %r unreadable (%s: %s); "
+                    "falling back to next-newest step", s, self.directory,
+                    type(e).__name__, e)
+        raise RuntimeError(
+            f"all {len(steps)} retained checkpoints under "
+            f"{self.directory!r} are unreadable") from last_err
+
+    def _load_step(self, step: int) -> dict:
         d = self._step_dir(step)
         out: dict = {}
         npz = os.path.join(d, "payload.npz")

@@ -5,12 +5,19 @@ Role-equivalent to FaultToleranceUtils.retryWithTimeout
 network init (lightgbm/TrainUtils.scala:662) and VW training
 (vw/VowpalWabbitBase.scala:347): run `fn` under a timeout, retrying with
 exponential backoff.
+
+The loop shape (jittered backoff, overall deadline, retry budget) is owned
+by `reliability.policy.RetryPolicy`; this module adds only the per-attempt
+hard timeout (thread-pool + abandoned-thread semantics). `times × timeout +
+sleeps` can no longer exceed a caller's budget: pass `deadline=` and every
+per-attempt timeout is clamped to what remains.
 """
 from __future__ import annotations
 
 import concurrent.futures
-import time
-from typing import Callable, TypeVar
+from typing import Callable, Optional, TypeVar
+
+from ..reliability.policy import RetryPolicy
 
 T = TypeVar("T")
 
@@ -18,26 +25,38 @@ T = TypeVar("T")
 def retry_with_timeout(fn: Callable[[], T], times: int = 3,
                        timeout: float = 60.0, backoff: float = 0.1,
                        backoff_factor: float = 2.0,
-                       retry_on: tuple = (Exception,)) -> T:
+                       retry_on: tuple = (Exception,),
+                       jitter: float = 0.1,
+                       deadline: Optional[float] = None,
+                       policy: Optional[RetryPolicy] = None) -> T:
     """Call fn() with a per-attempt timeout; on failure retry up to `times`
-    total attempts with exponential backoff. Raises the last error."""
-    last: BaseException = RuntimeError("retry_with_timeout: times < 1")
-    delay = backoff
+    total attempts with jittered exponential backoff, never exceeding the
+    overall `deadline` (seconds). Raises the last error. A prebuilt
+    `policy` overrides the loop-shape arguments."""
+    if policy is None:
+        if times < 1:
+            raise RuntimeError("retry_with_timeout: times < 1")
+        policy = RetryPolicy(max_attempts=times, backoff=backoff,
+                             backoff_factor=backoff_factor, jitter=jitter,
+                             deadline=deadline, retry_on=retry_on,
+                             metric_name="retry.retries")
+    last: BaseException = RuntimeError("retry_with_timeout: no attempts ran")
     # one shared executor torn down with shutdown(wait=False): a hung
     # attempt's thread is abandoned rather than joined — `with
     # ThreadPoolExecutor(...)` would block shutdown on the hung fn and
     # defeat the timeout entirely
     pool = concurrent.futures.ThreadPoolExecutor(
-        max_workers=times, thread_name_prefix="retry_with_timeout")
+        max_workers=policy.max_attempts, thread_name_prefix="retry_with_timeout")
     try:
-        for attempt in range(times):
+        for attempt in policy.attempts():
+            per_attempt = attempt.timeout(timeout)
+            if per_attempt is not None and per_attempt <= 0:
+                break  # deadline exhausted before the attempt could start
             try:
-                return pool.submit(fn).result(timeout=timeout)
-            except retry_on as e:  # includes FutureTimeoutError
+                return pool.submit(fn).result(timeout=per_attempt)
+            except policy.retry_on as e:  # includes FutureTimeoutError
                 last = e
-                if attempt + 1 < times:
-                    time.sleep(delay)
-                    delay *= backoff_factor
+                attempt.retry()
         raise last
     finally:
         pool.shutdown(wait=False)

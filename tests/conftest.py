@@ -17,15 +17,48 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # that constantly (observed: "Expected 8 threads to join the rendezvous,
 # but only 6 of them arrived on time"). Starvation is not deadlock: raise
 # the termination timeout so slow scheduling finishes instead of killing
-# the run. Must be in XLA_FLAGS before the backend initializes.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
-    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120")
+# the run. Must be in XLA_FLAGS before the backend initializes — but ONLY
+# when this jaxlib defines the flags: XLA also LOG(FATAL)s on unknown
+# XLA_FLAGS, so probe the extension binaries for the flag-name string
+# before passing it (older jaxlibs predate these knobs).
+
+
+def _jaxlib_knows_flag(flag: str) -> bool:
+    import glob
+    import mmap
+
+    import jaxlib
+    root = os.path.dirname(jaxlib.__file__)
+    for so in glob.glob(os.path.join(root, "**", "*.so"), recursive=True):
+        try:
+            with open(so, "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                try:
+                    if mm.find(flag.encode()) >= 0:
+                        return True
+                finally:
+                    mm.close()
+        except (OSError, ValueError):
+            continue
+    return False
+
+
+if _jaxlib_knows_flag("xla_cpu_collective_call_terminate_timeout_seconds"):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.4.34-ish) spells the virtual-device count as an XLA
+    # flag; the backend initializes lazily, so appending after `import jax`
+    # but before any device query still takes effect
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 # persistent compile cache: the suite compiles thousands of XLA programs in
 # one process; re-runs load them from disk instead (also sidesteps a
 # rare LLVM crash observed when the same program recompiles late in a

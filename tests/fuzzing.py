@@ -2,7 +2,12 @@
 (core/test/fuzzing/Fuzzing.scala): every stage gets the same inherited checks —
 experiment (fit+transform runs), serialization round-trip at stage / fitted-model /
 Pipeline / PipelineModel granularity, and output equality after reload.
+
+Malformed-payload generation for the HTTP/serving ingress suites routes
+through `reliability.faults.FaultInjector` (`malformed_http_payloads`), so
+every fuzz case is reproducible from the seed the test prints.
 """
+import json
 import os
 import tempfile
 
@@ -10,6 +15,7 @@ import numpy as np
 
 from mmlspark_tpu import Estimator, Pipeline, PipelineModel, Table, Transformer
 from mmlspark_tpu.core.model_equality import assert_stages_equal
+from mmlspark_tpu.reliability.faults import FaultInjector
 
 
 def assert_tables_equal(a: Table, b: Table, rtol=1e-5, atol=1e-6, cols=None):
@@ -36,6 +42,37 @@ def assert_tables_equal(a: Table, b: Table, rtol=1e-5, atol=1e-6, cols=None):
                         assert va.tolist() == vb.tolist(), f"col {n} row {i}"
                 else:
                     assert va == vb, f"col {n} row {i}: {va!r} != {vb!r}"
+
+
+HTTP_FUZZ_SEED = 20260804  # override with MMLSPARK_TPU_HTTP_FUZZ_SEED
+
+
+def malformed_http_payloads(seed=None, n=16):
+    """Deterministic malformed/truncated raw-HTTP fuzz cases.
+
+    Each case starts from a VALID `POST /` exchange and is mangled by the
+    seeded FaultInjector (truncate / byte-flip / garbage-splice), so the
+    whole corpus reproduces from one printed seed:
+
+        seed, injector, cases = malformed_http_payloads()
+        # a failure report shows the seed; rerun with
+        # MMLSPARK_TPU_HTTP_FUZZ_SEED=<seed> to replay the identical corpus
+
+    Returns (seed, injector, [bytes]) — `injector.schedule()` names the
+    corruption applied per case."""
+    if seed is None:
+        seed = int(os.environ.get("MMLSPARK_TPU_HTTP_FUZZ_SEED",
+                                  HTTP_FUZZ_SEED))
+    print(f"malformed_http_payloads seed={seed} "
+          f"(MMLSPARK_TPU_HTTP_FUZZ_SEED replays)")
+    inj = FaultInjector(seed=seed)
+    cases = []
+    for i in range(n):
+        body = json.dumps({"x": i, "pad": "p" * (i % 7)}).encode()
+        raw = (b"POST / HTTP/1.1\r\nContent-Type: application/json\r\n"
+               b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        cases.append(inj.corrupt_bytes("fuzz.http", raw))
+    return seed, inj, cases
 
 
 def roundtrip(stage):

@@ -10,6 +10,41 @@ from mmlspark_tpu.utils.checkpoint import CheckpointManager
 from mmlspark_tpu.utils import tracing
 
 
+@pytest.mark.chaos
+def test_restore_skips_corrupt_latest_step(tmp_path):
+    """A truncated payload.npz or garbage meta.json on the NEWEST retained
+    step must cost one checkpoint interval, not the run: restore() falls
+    back to the next-newest step (ISSUE 1 satellite regression)."""
+    from mmlspark_tpu.reliability import FaultInjector, reliability_metrics
+    reliability_metrics.reset(prefix="checkpoint.")
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=3)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": np.arange(step, dtype=np.float32),
+                        "iteration": step})
+    # seeded truncation of the newest step's array payload
+    FaultInjector(seed=13).corrupt_file(
+        os.path.join(mgr._step_dir(3), "payload.npz"))
+    out = mgr.restore()
+    assert out["iteration"] == 2
+    np.testing.assert_allclose(out["w"], np.arange(2))
+    assert reliability_metrics.get("checkpoint.corrupt_skipped") == 1
+    # an EXPLICITLY requested corrupt step still raises (caller asked)
+    with pytest.raises(Exception):
+        mgr.restore(3)
+    # garbage meta.json on the fallback step: skip once more
+    with open(os.path.join(mgr._step_dir(2), "meta.json"), "w") as f:
+        f.write("{corrupt json")
+    out = mgr.restore()
+    assert out["iteration"] == 1
+    # every retained step unreadable -> a clear error, not a crash loop
+    FaultInjector(seed=13).corrupt_file(
+        os.path.join(mgr._step_dir(1), "payload.npz"), site="ck2")
+    with open(os.path.join(mgr._step_dir(1), "meta.json"), "w") as f:
+        f.write("{")
+    with pytest.raises(RuntimeError, match="unreadable"):
+        mgr.restore()
+
+
 def test_manager_atomic_save_restore(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
     assert mgr.latest_step() is None

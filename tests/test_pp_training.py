@@ -55,9 +55,14 @@ def test_sgd_gradient_parity_across_pp_degrees():
     import subprocess
     import sys
     body = """
+import os
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax spells the count as an XLA flag
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 import numpy as np
 from mmlspark_tpu.parallel import DATA_AXIS, PIPE_AXIS, grid_mesh
 from mmlspark_tpu.models.dnn.pp_training import PipelinedLMTrainer

@@ -1,0 +1,311 @@
+"""Unit suite for the unified resilience layer (reliability/): RetryPolicy
+loop shape, Deadline propagation, RetryBudget, CircuitBreaker state machine,
+metrics registry, and FaultInjector seed-determinism. Everything runs on
+injected clocks/sleeps — no real waiting."""
+import json
+import os
+import time
+
+import pytest
+
+from mmlspark_tpu.reliability import (CircuitBreaker, CircuitOpenError,
+                                      Deadline, FaultInjector, InjectedCrash,
+                                      InjectedFault, MetricsRegistry,
+                                      RetryBudget, RetryPolicy,
+                                      reliability_metrics)
+from mmlspark_tpu.utils.retry import retry_with_timeout
+
+
+# ---------------------------------------------------------------- deadline
+def test_deadline_clamp_and_expiry():
+    clk = [100.0]
+    d = Deadline.after(5.0, clock=lambda: clk[0])
+    assert d.remaining() == 5.0 and not d.expired()
+    assert d.clamp(60.0) == 5.0
+    assert d.clamp(1.0) == 1.0
+    assert d.clamp(None) == 5.0
+    clk[0] = 106.0
+    assert d.expired() and d.remaining() == 0.0
+    never = Deadline.never()
+    assert not never.expired() and never.clamp(None) is None
+    assert never.clamp(3.0) == 3.0
+
+
+# ---------------------------------------------------------------- retry policy
+def test_retry_policy_succeeds_after_failures():
+    sleeps = []
+    p = RetryPolicy(max_attempts=5, backoff=0.1, jitter=0.0,
+                    sleep=sleeps.append, metrics=MetricsRegistry())
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.1, 0.2]  # exponential, no jitter
+
+
+def test_retry_policy_jitter_is_bounded_and_seeded():
+    import random
+    p = RetryPolicy(backoff=1.0, backoff_factor=1.0, jitter=0.25,
+                    rng=random.Random(3))
+    delays = [p.delay_for(0) for _ in range(50)]
+    assert all(0.75 <= d <= 1.25 for d in delays)
+    assert len(set(delays)) > 1  # actually jittered
+    p2 = RetryPolicy(backoff=1.0, backoff_factor=1.0, jitter=0.25,
+                     rng=random.Random(3))
+    assert delays == [p2.delay_for(0) for _ in range(50)]  # seed-reproducible
+
+
+def test_retry_policy_deadline_stops_loop():
+    clk = [0.0]
+
+    def fake_sleep(s):
+        clk[0] += s
+
+    p = RetryPolicy(max_attempts=100, backoff=1.0, jitter=0.0, deadline=2.5,
+                    sleep=fake_sleep, clock=lambda: clk[0],
+                    metrics=MetricsRegistry())
+    n = [0]
+
+    def fails():
+        n[0] += 1
+        raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        p.call(fails)
+    # attempt, sleep 1.0, attempt, sleep clamped to 1.5 -> expired -> stop
+    assert n[0] == 2
+    assert clk[0] <= 2.5 + 1e-9
+
+
+def test_retry_policy_budget_caps_retries():
+    budget = RetryBudget(tokens=2.0, success_credit=0.0)
+    p = RetryPolicy(max_attempts=50, backoff=0.0, jitter=0.0, budget=budget,
+                    sleep=lambda s: None, metrics=MetricsRegistry())
+    n = [0]
+
+    def fails():
+        n[0] += 1
+        raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        p.call(fails)
+    assert n[0] == 3  # initial attempt + 2 budgeted retries
+    # a second caller sharing the budget gets NO retries
+    n[0] = 0
+    with pytest.raises(ValueError):
+        p.call(fails)
+    assert n[0] == 1
+
+
+def test_retry_policy_counts_retries_in_metrics():
+    reg = MetricsRegistry()
+    p = RetryPolicy(max_attempts=3, backoff=0.0, jitter=0.0,
+                    sleep=lambda s: None, metrics=reg, metric_name="t.retries")
+    with pytest.raises(ValueError):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    assert reg.get("t.retries") == 2
+
+
+def test_attempt_explicit_delay_overrides_backoff():
+    sleeps = []
+    p = RetryPolicy(max_attempts=3, backoff=5.0, jitter=0.0,
+                    sleep=sleeps.append, metrics=MetricsRegistry())
+    for att in p.attempts():
+        if att.index == 2:
+            break
+        att.retry(delay=0.01)  # Retry-After style
+    assert sleeps == [0.01, 0.01]
+
+
+# ---------------------------------------------------------------- utils.retry
+def test_retry_with_timeout_keeps_existing_contract():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ValueError("boom")
+        return 17
+
+    assert retry_with_timeout(flaky, times=3, backoff=0.001) == 17
+    with pytest.raises(ZeroDivisionError):
+        retry_with_timeout(lambda: 1 / 0, times=2, backoff=0.001)
+    with pytest.raises(RuntimeError, match="times < 1"):
+        retry_with_timeout(lambda: 1, times=0)
+
+
+def test_retry_with_timeout_deadline_bounds_total_time():
+    """times x timeout + sleeps may not exceed the caller's budget."""
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        retry_with_timeout(lambda: (_ for _ in ()).throw(ValueError("x")),
+                           times=100, timeout=60.0, backoff=0.05,
+                           deadline=0.15)
+    assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------- breaker
+def test_circuit_breaker_state_machine():
+    clk = [0.0]
+    reg = MetricsRegistry()
+    b = CircuitBreaker(failure_threshold=3, failure_rate=0.5, window=10,
+                       reset_timeout=5.0, clock=lambda: clk[0], metrics=reg,
+                       name="svc")
+    assert b.state == "closed" and b.allow()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open" and not b.allow()
+    assert reg.get("svc.trips") == 1
+    # before the reset window: still open
+    clk[0] = 4.0
+    assert not b.allow()
+    # after: half-open admits exactly ONE probe
+    clk[0] = 6.0
+    assert b.allow()
+    assert not b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    # a failing probe re-opens (and counts a trip)
+    for _ in range(3):
+        b.record_failure()
+    clk[0] = 20.0
+    assert b.allow()
+    b.record_failure()
+    assert b.state == "open"
+    assert reg.get("svc.trips") == 3
+
+
+def test_circuit_breaker_failure_rate_threshold():
+    """Mostly-successful traffic never trips even past the count floor."""
+    b = CircuitBreaker(failure_threshold=3, failure_rate=0.5, window=10,
+                       metrics=MetricsRegistry())
+    for _ in range(4):
+        for _ in range(3):
+            b.record_success()
+        b.record_failure()
+    assert b.state == "closed"
+
+
+def test_circuit_breaker_call_raises_when_open():
+    clk = [0.0]
+    b = CircuitBreaker(failure_threshold=1, failure_rate=1.0, window=4,
+                       reset_timeout=9.0, clock=lambda: clk[0],
+                       metrics=MetricsRegistry())
+    with pytest.raises(ValueError):
+        b.call(lambda: (_ for _ in ()).throw(ValueError("dead dependency")))
+    with pytest.raises(CircuitOpenError):
+        b.call(lambda: "never runs")
+    clk[0] = 10.0
+    assert b.call(lambda: "probe ok") == "probe ok"
+    assert b.state == "closed"
+
+
+# ---------------------------------------------------------------- metrics
+def test_metrics_registry_counters_and_wall_clock_sink():
+    from mmlspark_tpu.utils import tracing
+    reg = MetricsRegistry()
+    reg.inc("a")
+    reg.inc("a", 2)
+    reg.inc("b.x")
+    with tracing.wall_clock("replay", sink=reg.observe):
+        pass
+    snap = reg.snapshot()
+    assert snap["a"] == 3 and snap["b.x"] == 1
+    assert snap["replay.count"] == 1 and snap["replay.seconds"] >= 0
+    reg.reset(prefix="b.")
+    assert reg.get("b.x") == 0 and reg.get("a") == 3
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+# ---------------------------------------------------------------- faults
+@pytest.mark.chaos
+def test_fault_injector_same_seed_same_schedule():
+    def run(seed):
+        inj = FaultInjector(seed=seed, rules=[
+            {"site": "w", "kind": "crash", "at": [1]},
+            {"site": "serving.*", "kind": "reset", "prob": 0.4},
+        ])
+        for _ in range(6):
+            inj.fire("serving.ingress")
+        for _ in range(3):
+            try:
+                inj.perturb("w")
+            except InjectedFault:
+                pass
+        return inj.schedule()
+
+    assert run(7) == run(7)
+    assert run(7) != run(123456)  # a different seed moves the prob fires
+
+
+@pytest.mark.chaos
+def test_fault_injector_kinds_and_wrap():
+    inj = FaultInjector(seed=1, rules=[
+        {"site": "f", "kind": "error", "at": [0]},
+        {"site": "f", "kind": "crash", "at": [1]},
+        {"site": "f", "kind": "delay", "at": [2], "param": 99.0},
+    ], sleep=lambda s: slept.append(s))
+    slept = []
+    wrapped = inj.wrap("f", lambda: "ran")
+    with pytest.raises(InjectedFault):
+        wrapped()
+    with pytest.raises(InjectedCrash):
+        wrapped()
+    assert wrapped() == "ran"
+    # injected delays are capped (chaos tests stay fast)
+    assert slept == [pytest.approx(0.2)]
+    assert [k for _, _, k in inj.schedule()] == ["error", "crash", "delay"]
+
+
+@pytest.mark.chaos
+def test_fault_injector_corrupt_bytes_deterministic():
+    data = b"POST / HTTP/1.1\r\nContent-Length: 5\r\n\r\nhello"
+    out1 = [FaultInjector(seed=9).corrupt_bytes("c", data) for _ in range(1)]
+    a, b = FaultInjector(seed=9), FaultInjector(seed=9)
+    seq_a = [a.corrupt_bytes("c", data) for _ in range(8)]
+    seq_b = [b.corrupt_bytes("c", data) for _ in range(8)]
+    assert seq_a == seq_b
+    assert any(x != data for x in seq_a)  # actually corrupts
+    assert out1[0] == seq_a[0]
+    modes = {k.split(":")[1] for _, _, k in a.schedule()}
+    assert modes <= {"truncate", "flip", "garbage"} and len(modes) >= 2
+
+
+@pytest.mark.chaos
+def test_fault_injector_from_env(monkeypatch):
+    monkeypatch.delenv("MMLSPARK_TPU_FAULTS", raising=False)
+    assert FaultInjector.from_env() is None  # disabled = zero overhead
+    monkeypatch.setenv("MMLSPARK_TPU_FAULTS", json.dumps(
+        {"seed": 5, "rules": [{"site": "x", "kind": "error", "at": [0]}]}))
+    inj = FaultInjector.from_env()
+    assert inj is not None and inj.seed == 5
+    with pytest.raises(InjectedFault):
+        inj.perturb("x")
+
+
+@pytest.mark.chaos
+def test_fault_injector_corrupt_file_truncates(tmp_path):
+    p = tmp_path / "payload.bin"
+    p.write_bytes(b"x" * 1000)
+    inj = FaultInjector(seed=4)
+    inj.corrupt_file(str(p))
+    assert p.stat().st_size < 1000
+    # same seed, same truncation point
+    p2 = tmp_path / "payload2.bin"
+    p2.write_bytes(b"x" * 1000)
+    FaultInjector(seed=4).corrupt_file(str(p2))
+    assert p.stat().st_size == p2.stat().st_size
+
+
+def test_global_metrics_registry_is_shared():
+    reliability_metrics.reset(prefix="t_shared.")
+    reliability_metrics.inc("t_shared.x")
+    assert reliability_metrics.get("t_shared.x") == 1
+    reliability_metrics.reset(prefix="t_shared.")
