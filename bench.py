@@ -224,6 +224,118 @@ def _bench_gbdt_e2e():
         "mb_per_sec": round(xc.nbytes / csv_s / 1e6, 1)}))
 
 
+def _bench_ingest():
+    """Parallel host ingest pipeline (data/) vs the recorded single-core
+    path: the round-5 verdict measured the 8M x 32 end-to-end fit as 9.7 s
+    of host binning in front of 1.85 s of device training. This section
+    times, at the same shape:
+
+    - sequential_s: the legacy serial staging — host apply_bins (native
+      C++ if the host has a compiler, else numpy) then ONE whole-matrix
+      device_put, stages strictly in sequence;
+    - pipeline_s: data.stage_binned — chunked apply_bins on the worker
+      pool, each chunk's device_put overlapped with the next chunk's
+      binning behind a bounded prefetch queue;
+
+    asserts the parallel bin matrix is BIT-IDENTICAL to the sequential
+    one, then trains the same short booster on both staged matrices so
+    the artifact shows device step time unchanged. Queue/stage metrics
+    from reliability.metrics ride along in the JSON."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.data import IngestOptions, stage_binned
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+    from mmlspark_tpu.native import apply_bins_native
+    from mmlspark_tpu.ops import binning
+    from mmlspark_tpu.reliability.metrics import reliability_metrics
+
+    n_rows, n_feat, max_bin = N_ROWS, N_FEATURES, 63
+    n_iters = int(os.environ.get("BENCH_INGEST_ITERS", 5))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
+    w = rng.normal(size=n_feat)
+    y = (x @ w + rng.normal(scale=0.5, size=n_rows) > 0).astype(np.float32)
+
+    mapper = binning.fit_bins(x, max_bin=max_bin, seed=0)
+
+    def sync(arr):
+        arr.block_until_ready()
+        float(jnp.asarray(arr)[0, 0])   # tunnel-safe sync (see gbdt_e2e)
+
+    # -- sequential recorded path -------------------------------------------
+    t0 = time.time()
+    bins_seq = apply_bins_native(x, mapper.upper_bounds[:, :-1],
+                                 mapper.upper_bounds.shape[1])
+    native = bins_seq is not None
+    if bins_seq is None:
+        bins_seq = binning.apply_bins(mapper, x)
+    bin_seq_s = time.time() - t0
+    t0 = time.time()
+    d_seq = jax.device_put(bins_seq)
+    sync(d_seq)
+    h2d_seq_s = time.time() - t0
+    sequential_s = bin_seq_s + h2d_seq_s
+
+    # -- pipelined path ------------------------------------------------------
+    opts = IngestOptions(num_workers=int(os.environ.get("BENCH_INGEST_WORKERS",
+                                                        0)))
+    n_workers = opts.pool().num_workers
+    reliability_metrics.reset("data.")
+    t0 = time.time()
+    d_par = stage_binned(mapper, x, opts)
+    sync(d_par)
+    pipeline_s = time.time() - t0
+
+    identical = bool(np.array_equal(np.asarray(d_par), bins_seq))
+
+    # -- device step time on both staged matrices ---------------------------
+    params = BoostParams(objective="binary", num_iterations=n_iters,
+                         num_leaves=31, max_depth=5, max_bin=max_bin,
+                         min_data_in_leaf=20)
+    d_y = jax.device_put(y)
+    fit_booster(x, y, params, prebinned=(mapper, d_seq, d_y))   # compile
+    t0 = time.time()
+    fit_booster(x, y, params, prebinned=(mapper, d_seq, d_y))
+    train_seq_s = time.time() - t0
+    t0 = time.time()
+    fit_booster(x, y, params, prebinned=(mapper, d_par, d_y))
+    train_par_s = time.time() - t0
+
+    snap = reliability_metrics.snapshot()
+    # what the host binning ADDS to the staging critical path once it
+    # overlaps the transfer (vs the recorded 9.7 s where it strictly
+    # PRECEDED it): on a transfer-bound link this approaches zero even on
+    # a 1-core host; on a fast link it is the multi-worker binning time
+    binning_added = max(pipeline_s - h2d_seq_s, 0.0)
+    print(json.dumps({
+        "metric": "ingest_host_binning_wall_s", "value": round(pipeline_s, 3),
+        "unit": "s",
+        # >1 means the pipeline beats the serial staging it replaces
+        "vs_baseline": round(sequential_s / max(pipeline_s, 1e-9), 3),
+        "shape": f"{n_rows}x{n_feat}x{max_bin + 1}bins",
+        "sequential_s": round(sequential_s, 3),
+        "sequential_bin_s": round(bin_seq_s, 3),
+        "sequential_h2d_s": round(h2d_seq_s, 3),
+        "pipeline_s": round(pipeline_s, 3),
+        "speedup": round(sequential_s / max(pipeline_s, 1e-9), 3),
+        "binning_wall_added_s": round(binning_added, 3),
+        "binning_speedup_vs_serial": round(
+            bin_seq_s / max(binning_added, 1e-9), 3),
+        "bit_identical": identical,
+        "num_workers": n_workers,
+        "sequential_binner": "native_cpp" if native else "numpy",
+        "train_loop_seq_staged_s": round(train_seq_s, 3),
+        "train_loop_pipeline_staged_s": round(train_par_s, 3),
+        "bin_chunk_seconds_total": round(
+            snap.get("data.bin_chunk.seconds", 0.0), 3),
+        "bin_chunks": snap.get("data.bin_chunk.count", 0),
+        "prefetch_put_seconds_total": round(
+            snap.get("data.prefetch.put.seconds", 0.0), 3),
+        "prefetch_full_events": snap.get("data.prefetch.full", 0),
+        "prefetch_stalls": snap.get("data.prefetch.stalls", 0)}))
+    assert identical, "parallel binning diverged from the sequential path"
+
+
 def _bench_serving():
     """Model-in-the-loop serving (round-4 verdict item 5): a REAL fitted
     GBDT booster behind ServingQuery — not an echo lambda. Reports
@@ -594,6 +706,8 @@ def main():
         return _bench_lm_long_context()
     if mode == "gbdt_e2e":
         return _bench_gbdt_e2e()
+    if mode == "ingest":
+        return _bench_ingest()
     if mode == "serving":
         return _bench_serving()
     # predict/shap modes never print the bandwidth fields — don't spend the

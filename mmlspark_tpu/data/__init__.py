@@ -1,0 +1,23 @@
+"""Parallel host ingest: the Spark-partitions analog for this framework.
+
+The reference inherits ingest parallelism from Spark — partitioned
+DataFrames stream into LightGBM per executor task. Here the equivalent is
+explicit: `ChunkSource` splits a Table/array/file into ordered row-range
+chunks, `WorkerPool` maps per-chunk transforms (binning, featurize) over
+processes with shared-memory buffers (threaded fallback), and
+`DevicePrefetcher` double-buffers host->device transfer so ingest overlaps
+device compute instead of preceding it. See docs/data.md.
+"""
+from .chunk import Chunk, ChunkSource, default_chunk_rows, make_chunks
+from .pool import WorkerCrashError, WorkerPool
+from .prefetch import DevicePrefetcher, prefetch_to_device
+from .pipeline import (IngestOptions, IngestPipeline, ParallelTransform,
+                       parallel_apply_bins, stage_binned)
+
+__all__ = [
+    "Chunk", "ChunkSource", "default_chunk_rows", "make_chunks",
+    "WorkerPool", "WorkerCrashError",
+    "DevicePrefetcher", "prefetch_to_device",
+    "IngestOptions", "IngestPipeline", "ParallelTransform",
+    "parallel_apply_bins", "stage_binned",
+]

@@ -1,0 +1,252 @@
+"""Parallel host ingest pipeline: chunked transforms overlapped with the
+device feed.
+
+This is the subsystem-level composition of the three data/ primitives —
+
+    ChunkSource  ->  WorkerPool (bin / featurize per chunk)  ->
+    DevicePrefetcher (device_put chunk k+1 while k transfers/trains)
+
+— the Spark-partitions analog for this framework's single-host Tables. The
+round-5 verdict measured the 8M x 32 end-to-end GBDT fit as 9.7 s of
+single-core host binning in front of 1.85 s of device training; the pipeline
+attacks both terms: chunk transforms run on every core, and the device feed
+streams per chunk instead of waiting for the whole matrix
+(CTA-pipelining's lesson: overlap stages, don't just speed one up).
+
+Determinism contract (tested): for any row-independent transform, output is
+bit-identical to the sequential path for every `num_workers`/`chunk_rows`/
+backend combination — chunks are contiguous ordered row ranges and results
+are written back by range, never by completion order.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..reliability.metrics import reliability_metrics
+from ..utils import tracing
+from .chunk import ChunkSource, default_chunk_rows, make_chunks
+from .pool import WorkerPool
+from .prefetch import DevicePrefetcher
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestOptions:
+    """Knobs for the parallel host ingest path (estimator Params mirror
+    these 1:1 — see _GBDTParams.num_ingest_workers and docs/data.md)."""
+    num_workers: int = 0        # 0 = all cores; 1 = sequential (legacy path)
+    mode: str = "auto"          # process | thread | auto (WorkerPool)
+    chunk_rows: int = 0         # 0 = auto (~32 MB of input per chunk)
+    prefetch: int = 2           # bounded device-feed depth (double buffer)
+
+    def pool(self, faults=None, metrics=None) -> WorkerPool:
+        return WorkerPool(num_workers=self.num_workers, mode=self.mode,
+                          faults=faults, metrics=metrics)
+
+
+def _bin_rows(mapper, rows: np.ndarray) -> np.ndarray:
+    """Module-level so the process pool can pickle it by reference.
+
+    Prefers the native C++ binner — the SAME kernel whose single-core run
+    is the recorded 9.7 s baseline (ctypes CDLL calls drop the GIL, so
+    thread workers scale it across cores); numpy fallback is pinned
+    bit-identical to it by test_native_apply_bins_matches_python, so the
+    determinism contract holds whichever kernel a chunk lands on."""
+    from ..native import apply_bins_native
+    from ..ops import binning
+    if (mapper.categorical is not None and mapper.categorical.any()) \
+            or rows.dtype != np.float32:
+        # identity-binned categorical columns use k = max_bin + 1 bins,
+        # which the (max_bin - 1)-bound native call can't represent; and
+        # non-f32 inputs must bin at THEIR dtype like the serial path
+        # does (an f32 downcast can flip a searchsorted boundary) —
+        # numpy handles both exactly
+        return binning.apply_bins(mapper, rows)
+    out = apply_bins_native(rows, mapper.upper_bounds[:, :-1],
+                            mapper.upper_bounds.shape[1])
+    if out is None:
+        return binning.apply_bins(mapper, rows)
+    # the native kernel sends NaN to the GLOBAL last bin; ops.binning uses
+    # the PER-FEATURE last bin (k-1). Identical when a feature uses the
+    # full bin width — fix up the low-cardinality columns so the pipeline
+    # stays bit-identical to apply_bins whichever kernel a chunk hits.
+    for j in np.nonzero(mapper.n_bins < mapper.upper_bounds.shape[1])[0]:
+        miss = np.isnan(rows[:, j])
+        if miss.any():
+            out[miss, j] = mapper.n_bins[j] - 1
+    return out
+
+
+def parallel_apply_bins(mapper, x: np.ndarray,
+                        opts: Optional[IngestOptions] = None,
+                        faults=None) -> np.ndarray:
+    """Multi-worker `ops.binning.apply_bins`: (n, F) f32 -> (n, F) uint8,
+    bit-identical to the sequential call (binning is row-independent)."""
+    opts = opts or IngestOptions()
+    pool = opts.pool(faults=faults)
+    with tracing.wall_clock("data.apply_bins",
+                            sink=reliability_metrics.observe):
+        # no dtype cast: chunks bin at the INPUT's dtype, exactly like the
+        # sequential call (an f32 downcast of f64 features could flip a
+        # bin-boundary compare and break bit-identity)
+        return pool.map_rows(functools.partial(_bin_rows, mapper),
+                             np.asarray(x),
+                             out_width=mapper.n_features,
+                             out_dtype=np.uint8,
+                             chunk_rows=opts.chunk_rows)
+
+
+_update_slice_jit = None
+
+
+def _get_update_slice():
+    """Donated row-block writer: buf is donated so XLA updates the bin
+    matrix IN PLACE on accelerators — peak device memory stays one matrix
+    plus one in-flight chunk, where a concatenate of all staged chunks
+    would transiently hold ~2x the matrix. Traced offset: one executable
+    per chunk SHAPE (two at most — body chunks and the ragged tail)."""
+    global _update_slice_jit
+    if _update_slice_jit is None:
+        import functools as _ft
+
+        import jax
+
+        @_ft.partial(jax.jit, donate_argnums=(0,))
+        def _upd(buf, chunk, lo):
+            return jax.lax.dynamic_update_slice(buf, chunk, (lo, 0))
+
+        _update_slice_jit = _upd
+    return _update_slice_jit
+
+
+def stage_binned(mapper, x: np.ndarray, opts: Optional[IngestOptions] = None,
+                 put: Optional[Callable] = None, faults=None):
+    """Bin on host workers AND stream chunks to the device concurrently:
+    chunk k+1 bins while chunk k rides `device_put`, behind a bounded
+    prefetch queue. Returns the full on-device (n, F) uint8 bin matrix.
+
+    This replaces the serial `apply_bins -> device_put(whole matrix)`
+    staging in the GBDT fit: host binning no longer PRECEDES the upload,
+    it overlaps it. On accelerators chunks land in a donated device buffer
+    (in-place dynamic_update_slice); on CPU — where jit ignores donation
+    and every update would copy the whole buffer — chunks are concatenated
+    once instead."""
+    import jax
+    import jax.numpy as jnp
+    opts = opts or IngestOptions()
+    put = put or jax.device_put
+    pool = opts.pool(faults=faults)
+    x = np.asarray(x)   # bin at the input's dtype, like the serial path
+    n = x.shape[0]
+    fn = functools.partial(_bin_rows, mapper)
+    in_place = jax.devices()[0].platform != "cpu"
+    with tracing.wall_clock("data.stage_binned",
+                            sink=reliability_metrics.observe):
+        source = (rows for _c, rows in pool.imap_rows(
+            fn, x, chunk_rows=opts.chunk_rows))
+        with DevicePrefetcher(source, depth=opts.prefetch, put=put) as pf:
+            if in_place:
+                upd = _get_update_slice()
+                buf = jnp.zeros((n, mapper.n_features), jnp.uint8)
+                lo = 0
+                for dev_chunk in pf:
+                    buf = upd(buf, dev_chunk, jnp.int32(lo))
+                    lo += dev_chunk.shape[0]
+                return buf
+            parts = list(pf)
+        if not parts:   # zero-row input: an empty matrix, not a crash
+            return put(np.zeros((0, mapper.n_features), np.uint8))
+        d_bins = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return d_bins
+
+
+class ParallelTransform:
+    """Wrap a row-independent Table->Table transform so it maps over row
+    chunks on the worker pool with order-preserving reassembly — the drop-in
+    used by `io.streaming.FileStreamQuery(num_workers=...)` and by featurize
+    stages over big Tables. Thread-backed (Table transforms close over
+    fitted models; the numpy kernels inside release the GIL)."""
+
+    def __init__(self, fn: Callable, opts: Optional[IngestOptions] = None,
+                 faults=None):
+        self.fn = fn
+        self.opts = opts or IngestOptions()
+        self._pool = self.opts.pool(faults=faults)
+
+    def __call__(self, table):
+        from .chunk import _table_slice, reassemble_tables
+        from .pool import _fire_chunk_faults
+        n = len(table)
+        chunk_rows = self.opts.chunk_rows or default_chunk_rows(
+            n, max(len(table.columns), 1), self._pool.num_workers)
+        chunks = make_chunks(n, chunk_rows)
+        if len(chunks) <= 1:
+            return self.fn(table)
+        parts = [None] * len(chunks)
+
+        def one(chunk):
+            _fire_chunk_faults(self._pool.faults, chunk.index)
+            parts[chunk.index] = self.fn(
+                _table_slice(table, chunk.lo, chunk.hi))
+
+        with tracing.wall_clock("data.table_transform",
+                                sink=reliability_metrics.observe):
+            self._pool.run_chunks(chunks, one)
+        return reassemble_tables(parts, npartitions=table.npartitions)
+
+
+class IngestPipeline:
+    """End-to-end chunked ingest: source -> per-chunk transform (pool) ->
+    bounded device prefetch. Iterating yields device-resident chunk results
+    in source order; `run()` materializes and returns them all.
+
+        pipe = IngestPipeline(x, transform=binner, opts=IngestOptions())
+        for dev_chunk in pipe:        # training consumes while ingest runs
+            step(dev_chunk)
+    """
+
+    def __init__(self, source, transform: Callable,
+                 opts: Optional[IngestOptions] = None,
+                 put: Optional[Callable] = None, faults=None):
+        self.opts = opts or IngestOptions()
+        self.source = (source if isinstance(source, ChunkSource)
+                       else ChunkSource(source, chunk_rows=self.opts.chunk_rows,
+                                        num_workers=self.opts.num_workers
+                                        or (WorkerPool(0).num_workers)))
+        self.transform = transform
+        self._pool = self.opts.pool(faults=faults)
+        if put is None:
+            import jax
+            put = jax.device_put
+        self._put = put
+
+    def _host_chunks(self):
+        for chunk, rows in self.source:
+            yield chunk, rows
+
+    def __iter__(self):
+        arr = self.source.array
+        if arr is not None:
+            src = (rows for _c, rows in self._pool.imap_rows(
+                self.transform, arr, chunk_rows=self.source.chunk_rows))
+        else:
+            # Table-backed source: thread map in chunk order
+            src = (self.transform(rows) for _c, rows in self._host_chunks())
+        # generator, not the raw prefetcher: a consumer that breaks early
+        # (early stopping, a raised step) must still close the feeder
+        # thread and drop its pinned chunk buffers
+        pf = DevicePrefetcher(src, depth=self.opts.prefetch, put=self._put)
+
+        def consume():
+            try:
+                for item in pf:
+                    yield item
+            finally:
+                pf.close()
+        return consume()
+
+    def run(self) -> list:
+        return list(self)

@@ -303,11 +303,14 @@ class _SelectorServer:
                 f"Connection: close\r\n\r\n").encode("latin-1") + payload
         conn.rbuf = b""   # the stream is desynced: never re-parse it
         if not conn.inflight and not conn.wbuf:
-            try:
-                conn.sock.send(resp)
-            except OSError:
-                pass
-            self._close(conn)
+            # even the "nothing queued" fast path must go through the write
+            # buffer: a direct send() on this non-blocking socket can accept
+            # only part of the reply (or none, EAGAIN) and the close would
+            # truncate the 4xx/501 mid-payload. wbuf + closing gets the
+            # partial-write retry and deferred close for free.
+            conn.wbuf += resp
+            conn.closing = True
+            self._send_buffered(conn)
             return
         conn.reject = resp
         self._flush(conn)

@@ -198,8 +198,21 @@ class FileStreamQuery:
     MAX_BACKOFF = 1.0
 
     def __init__(self, source: FileStreamSource, transform_fn: Callable,
-                 sink: Callable, poll_interval: float = 0.05):
+                 sink: Callable, poll_interval: float = 0.05,
+                 num_workers: int = 1, chunk_rows: int = 0):
         self.source = source
+        # num_workers != 1 maps row-independent transforms over row chunks
+        # on the parallel ingest pool (data.ParallelTransform) with
+        # order-preserving reassembly — the partitioned-micro-batch analog
+        # of the reference's per-partition streaming tasks. Output (and
+        # therefore the commit/replay contract) is identical to the serial
+        # path; a worker failure surfaces like any transform error and the
+        # batch replays.
+        if num_workers != 1:
+            from ..data import IngestOptions, ParallelTransform
+            transform_fn = ParallelTransform(
+                transform_fn, IngestOptions(num_workers=num_workers,
+                                            chunk_rows=chunk_rows))
         self.transform_fn = transform_fn
         self.sink = sink
         self.poll_interval = poll_interval

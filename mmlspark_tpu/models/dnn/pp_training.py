@@ -207,7 +207,9 @@ class PipelinedLMTrainer:
             raise ValueError("attention must be dense|flash")
         if optimizer not in ("adam", "sgd"):
             raise ValueError("optimizer must be adam|sgd")
-        if remat not in (True, False, "full", "save_attn"):
+        # isinstance, not `in (True, False, ...)`: ints equal bools under
+        # tuple membership, so remat=1 would silently mean full remat
+        if not (isinstance(remat, bool) or remat in ("full", "save_attn")):
             raise ValueError("remat must be bool|'full'|'save_attn'")
         if compute_dtype not in ("float32", "bfloat16"):
             raise ValueError("compute_dtype must be float32|bfloat16")

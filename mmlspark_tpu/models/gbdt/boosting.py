@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops import binning
+from ...reliability.metrics import reliability_metrics
+from ...utils import tracing
 from . import objectives as obj_mod
 from . import trainer
 from .booster import Booster
@@ -380,10 +382,16 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 prebinned: Optional[tuple] = None,
                 presence: Optional[np.ndarray] = None,
                 checkpoint_fn=None, checkpoint_interval: int = 25,
-                init_base: float = 0.0):
+                init_base: float = 0.0, ingest=None):
     """Train a Booster on host arrays. Single-device by default; the
     distributed path (distributed.py) passes a shard_map-wrapped `tree_fn`
     and a sharding `put_fn`, and this same loop runs over the mesh.
+
+    `ingest` (a data.IngestOptions) routes the bin-matrix build through the
+    parallel host pipeline: chunked multi-worker apply_bins overlapped with
+    per-chunk device_put (data.stage_binned) instead of the serial
+    whole-matrix staging — the Spark-partitioned-ingest analog. Output is
+    bit-identical to the sequential path (tests/test_data_pipeline.py).
 
     Padded rows (distributed ragged handling) carry weight 0 and therefore
     contribute nothing to histograms, leaf values, or the init score.
@@ -412,9 +420,22 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             mapper, d_bins = prebinned
         d_bins = put(d_bins)
     else:
-        mapper = binning.fit_bins(x, max_bin=p.max_bin, seed=p.seed,
-                                  categorical_features=p.categorical_features)
-        d_bins = put(binning.apply_bins_device(mapper, x))
+        with tracing.wall_clock("data.fit_bins",
+                                sink=reliability_metrics.observe):
+            mapper = binning.fit_bins(
+                x, max_bin=p.max_bin, seed=p.seed,
+                categorical_features=p.categorical_features)
+        if ingest is not None:
+            from ...data import parallel_apply_bins, stage_binned
+            if put_fn is None:
+                # single-device: chunk binning overlaps the device feed
+                d_bins = stage_binned(mapper, x, ingest)
+            else:
+                # sharded put: bin host-parallel, place the whole matrix
+                # once (per-chunk placement would fight the row sharding)
+                d_bins = put(parallel_apply_bins(mapper, x, ingest))
+        else:
+            d_bins = put(binning.apply_bins_device(mapper, x))
     y_j = (put(staged_y.astype(jnp.float32)) if staged_y is not None
            else put(np.asarray(y, dtype=np.float32)))
     w_j = None if weights is None else put(np.asarray(weights, dtype=np.float32))
@@ -468,7 +489,11 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     has_valid = valid is not None
     if has_valid:
         vx, vy = valid
-        v_bins = jnp.asarray(binning.apply_bins(mapper, vx))
+        if ingest is not None:
+            from ...data import parallel_apply_bins
+            v_bins = jnp.asarray(parallel_apply_bins(mapper, vx, ingest))
+        else:
+            v_bins = jnp.asarray(binning.apply_bins(mapper, vx))
         if multiclass:
             v_margin = jnp.zeros((vx.shape[0], p.num_class), jnp.float32)
         else:

@@ -91,7 +91,7 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
                             callbacks=None, parallelism: str = "data_parallel",
                             top_k: int = 20, num_tasks: int = 0,
                             checkpoint_fn=None, checkpoint_interval: int = 25,
-                            init_base: float = 0.0):
+                            init_base: float = 0.0, ingest=None):
     """Same training loop as fit_booster, with rows sharded over the mesh.
 
     Split decisions are computed identically on every shard from the psum'd
@@ -146,5 +146,6 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
         valid=valid, init_booster=init_booster, callbacks=callbacks,
         tree_fn=tree_fn, put_fn=put_rows, chunk_fn=chunk_fn,
         presence=pres_p, checkpoint_fn=checkpoint_fn,
-        checkpoint_interval=checkpoint_interval, init_base=init_base)
+        checkpoint_interval=checkpoint_interval, init_base=init_base,
+        ingest=ingest)
     return booster, base, hist

@@ -98,6 +98,25 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     fobj = Param("fobj", "custom objective: (margin, y) -> (grad, hess) "
                  "(reference: FObjTrait.scala:17)", None, transient=True)
 
+    # parallel host ingest (data/ subsystem — the Spark-partitions analog;
+    # see docs/data.md). num_ingest_workers=1 keeps the legacy serial
+    # staging; 0 = all cores; >1 = that many workers. Parallel output is
+    # bit-identical to serial (tests/test_data_pipeline.py pins it).
+    num_ingest_workers = Param(
+        "num_ingest_workers",
+        "host ingest/binning workers (1=serial legacy path, 0=all cores)", 1,
+        validator=in_range(0))
+    ingest_mode = Param(
+        "ingest_mode", "worker pool backend: auto|process|thread", "auto",
+        validator=one_of("auto", "process", "thread"))
+    ingest_chunk_rows = Param(
+        "ingest_chunk_rows", "rows per ingest chunk (0=auto ~32MB)", 0,
+        validator=in_range(0))
+    ingest_prefetch = Param(
+        "ingest_prefetch",
+        "bounded host->device prefetch depth (double buffer)", 2,
+        validator=in_range(1))
+
     checkpoint_dir = Param(
         "checkpoint_dir",
         "step-checkpoint directory (utils.checkpoint.CheckpointManager); "
@@ -192,6 +211,13 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         params = self._resolve_categoricals(
             table, self._boost_params(objective, num_class))
         n_batches = self.num_batches or 0
+        ingest = None
+        if self.num_ingest_workers != 1:
+            from ...data import IngestOptions
+            ingest = IngestOptions(num_workers=self.num_ingest_workers,
+                                   mode=self.ingest_mode,
+                                   chunk_rows=self.ingest_chunk_rows,
+                                   prefetch=self.ingest_prefetch)
 
         # step-level checkpoint/resume (SURVEY.md §5); single-batch fits only
         ck_fn, resume_booster, done, resume_base = None, None, 0, 0.0
@@ -239,9 +265,9 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             from .distributed import fit_booster_distributed
             fit = lambda **kw: fit_booster_distributed(
                 parallelism=self.parallelism, top_k=self.top_k,
-                num_tasks=self.num_tasks, **kw)
+                num_tasks=self.num_tasks, ingest=ingest, **kw)
         else:
-            fit = fit_booster
+            fit = lambda **kw: fit_booster(ingest=ingest, **kw)
         if n_batches > 1:
             # batch continuation (reference: LightGBMBase.scala:34-51)
             booster, base, hist = None, 0.0, []

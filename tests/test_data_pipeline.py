@@ -1,0 +1,351 @@
+"""Parallel host ingest pipeline (mmlspark_tpu/data/): determinism,
+backpressure, crash propagation, overlap.
+
+The subsystem's whole value rests on one contract — the parallel path is
+bit-identical to the sequential one for every worker count / chunk size /
+backend — so most tests here are equality assertions against the serial
+reference, plus the scheduling properties (bounded queue, unstarved
+consumer) that make the overlap real.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import Table
+from mmlspark_tpu.data import (Chunk, ChunkSource, DevicePrefetcher,
+                               IngestOptions, ParallelTransform, WorkerPool,
+                               WorkerCrashError, make_chunks,
+                               parallel_apply_bins, stage_binned)
+from mmlspark_tpu.ops import binning
+from mmlspark_tpu.reliability.faults import FaultInjector
+from mmlspark_tpu.reliability.metrics import MetricsRegistry
+
+
+def _toy_features(n=20_000, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    # column 0 is low-cardinality (k << max_bin distinct-value bins): its
+    # NaN bin is the PER-FEATURE last bin, which the native kernel fast
+    # path must fix up to stay bit-identical to ops.binning.apply_bins
+    x[:, 0] = rng.integers(0, 5, size=n).astype(np.float32)
+    x[rng.random(x.shape) < 0.02] = np.nan   # NaN routing must survive too
+    return x
+
+
+# -- chunking ---------------------------------------------------------------
+
+def test_chunks_cover_rows_contiguously_in_order():
+    chunks = make_chunks(1003, 100)
+    assert chunks[0] == Chunk(0, 0, 100)
+    assert chunks[-1] == Chunk(10, 1000, 1003)
+    for a, b in zip(chunks, chunks[1:]):
+        assert a.hi == b.lo and a.index + 1 == b.index
+    assert sum(c.n_rows for c in chunks) == 1003
+
+
+def test_chunk_source_file_backed_npy(tmp_path):
+    x = _toy_features(5000, 4)
+    path = str(tmp_path / "rows.npy")
+    np.save(path, x)
+    src = ChunkSource(path, chunk_rows=1024)
+    got = np.concatenate([rows for _c, rows in src])
+    assert np.array_equal(got, x, equal_nan=True)
+
+
+# -- determinism: binning ----------------------------------------------------
+
+@pytest.mark.parametrize("num_workers", [1, 2, 4])
+def test_parallel_binning_bit_identical(num_workers):
+    x = _toy_features()
+    mapper = binning.fit_bins(x, max_bin=63)
+    seq = binning.apply_bins(mapper, x)
+    par = parallel_apply_bins(
+        mapper, x, IngestOptions(num_workers=num_workers, mode="thread",
+                                 chunk_rows=3000))
+    assert par.dtype == seq.dtype
+    assert np.array_equal(par, seq)
+
+
+def test_parallel_binning_process_backend_bit_identical():
+    # the shared-memory process pool, forced on small data
+    x = _toy_features(8000, 6)
+    mapper = binning.fit_bins(x, max_bin=31)
+    seq = binning.apply_bins(mapper, x)
+    par = parallel_apply_bins(
+        mapper, x, IngestOptions(num_workers=2, mode="process",
+                                 chunk_rows=3000))
+    assert np.array_equal(par, seq)
+
+
+def test_parallel_binning_float64_input_bit_identical():
+    # no f32 downcast on the parallel path: f64 values adjacent to the f32
+    # bin boundaries must bin exactly like the sequential call
+    rng = np.random.default_rng(9)
+    x32 = rng.normal(size=(4000, 4)).astype(np.float32)
+    mapper = binning.fit_bins(x32, max_bin=31)
+    x64 = x32.astype(np.float64)
+    # nudge values to just above their f32 boundary (rounds DOWN in f32)
+    x64[::7] = np.nextafter(x64[::7], np.inf)
+    seq = binning.apply_bins(mapper, x64)
+    par = parallel_apply_bins(mapper, x64,
+                              IngestOptions(num_workers=2, chunk_rows=900))
+    assert np.array_equal(par, seq)
+
+
+def test_ingest_pipeline_early_break_closes_feeder():
+    from mmlspark_tpu.data import IngestPipeline
+    x = _toy_features(8000, 4)
+    pipe = IngestPipeline(x, transform=lambda rows: rows * 2,
+                          opts=IngestOptions(num_workers=2, chunk_rows=1000))
+    it = iter(pipe)
+    next(it)
+    it.close()    # early break: generator finally must close the feeder
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not any(t.name == "ingest-prefetch" and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    assert not any(t.name == "ingest-prefetch" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_parallel_binning_categorical_schema_bit_identical():
+    # identity-binned categorical columns force the numpy kernel (the
+    # native fast path can't represent k = max_bin + 1); still bit-equal
+    x = _toy_features(6000, 5)
+    mapper = binning.fit_bins(x, max_bin=63, categorical_features=(0,))
+    seq = binning.apply_bins(mapper, x)
+    par = parallel_apply_bins(mapper, x,
+                              IngestOptions(num_workers=3, chunk_rows=1000))
+    assert np.array_equal(par, seq)
+
+
+def test_stage_binned_matches_sequential_on_device():
+    x = _toy_features(12_000, 5)
+    mapper = binning.fit_bins(x, max_bin=63)
+    seq = binning.apply_bins(mapper, x)
+    for chunk_rows in (2000, 5000, 12_000):
+        d = stage_binned(mapper, x, IngestOptions(num_workers=2,
+                                                  chunk_rows=chunk_rows))
+        assert np.array_equal(np.asarray(d), seq), chunk_rows
+
+
+def test_fit_booster_ingest_path_matches_legacy():
+    # end-to-end: the ingest-staged fit must produce the same model
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(3000, 6)).astype(np.float32)
+    y = (x[:, 0] - x[:, 1] > 0).astype(np.float32)
+    p = BoostParams(objective="binary", num_iterations=4, max_depth=3,
+                    max_bin=31, min_data_in_leaf=5)
+    b_legacy, base_l, _ = fit_booster(x, y, p)
+    b_par, base_p, _ = fit_booster(
+        x, y, p, ingest=IngestOptions(num_workers=3, chunk_rows=700))
+    assert base_l == base_p
+    np.testing.assert_array_equal(b_legacy.split_feature, b_par.split_feature)
+    np.testing.assert_array_equal(b_legacy.split_bin, b_par.split_bin)
+    np.testing.assert_array_equal(b_legacy.leaf_value, b_par.leaf_value)
+
+
+# -- determinism: featurize over table chunks --------------------------------
+
+def _featurize_table(n=4000, seed=1):
+    rng = np.random.default_rng(seed)
+    return Table({
+        "a": rng.normal(size=n).astype(np.float32),
+        "b": rng.normal(size=(n, 3)).astype(np.float32),
+        "cat": np.asarray(rng.choice(["x", "y", "z"], size=n), dtype=object),
+        "label": rng.integers(0, 2, size=n).astype(np.float32)})
+
+
+@pytest.mark.parametrize("num_workers", [1, 2, 4])
+def test_parallel_featurize_bit_identical(num_workers):
+    from mmlspark_tpu.featurize.featurize import Featurize
+    t = _featurize_table()
+    model = Featurize(input_cols=["a", "b", "cat"]).fit(t)
+    ref = model.transform(t)
+    par = ParallelTransform(
+        model.transform, IngestOptions(num_workers=num_workers,
+                                       chunk_rows=900))(t)
+    assert par.columns == ref.columns
+    for c in ref.columns:
+        np.testing.assert_array_equal(np.asarray(par[c]), np.asarray(ref[c]))
+    assert par.npartitions == ref.npartitions
+
+
+def test_streaming_query_parallel_transform_same_sink_rows(tmp_path):
+    # FileStreamQuery(num_workers>1) must deliver the same committed rows
+    from mmlspark_tpu.io.streaming import FileStreamQuery, FileStreamSource
+    f = tmp_path / "s.csv"
+    f.write_text("v\n" + "".join(f"{i}\n" for i in range(500)))
+    got = []
+    src = FileStreamSource(str(tmp_path / "*.csv"), mode="csv")
+    q = FileStreamQuery(src, lambda t: t.with_column(
+        "doubled", np.asarray(t["v"]) * 2), got.append,
+        poll_interval=0.01, num_workers=3, chunk_rows=64).start()
+    try:
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        q.stop()
+    assert got, "stream never delivered a batch"
+    out = got[0]
+    np.testing.assert_array_equal(np.asarray(out["doubled"]),
+                                  np.arange(500, dtype=np.float32) * 2)
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_prefetch_queue_is_bounded():
+    depth = 2
+    produced = []
+
+    def put(item):
+        produced.append(item)
+        return item
+
+    metrics = MetricsRegistry()
+    pf = DevicePrefetcher(range(12), depth=depth, put=put, metrics=metrics)
+    consumed = 0
+    for _ in pf:
+        consumed += 1
+        time.sleep(0.01)   # slow consumer: the feeder must block, not race
+        # at most: `depth` queued + 1 being handed over + 1 inside put()
+        assert len(produced) - consumed <= depth + 2, \
+            (len(produced), consumed)
+    assert consumed == 12 and len(produced) == 12
+    assert metrics.get("data.prefetch.items") == 12
+
+
+def test_prefetch_close_releases_blocked_feeder():
+    pf = DevicePrefetcher(range(100), depth=1, put=lambda x: x)
+    it = iter(pf)
+    next(it)
+    pf.close()     # feeder blocked on the full queue must exit promptly
+    pf._thread.join(timeout=2)
+    assert not pf._thread.is_alive()
+
+
+# -- crash propagation -------------------------------------------------------
+
+def test_worker_crash_propagates_with_chunk_index():
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "data.worker.chunk2", "kind": "crash", "at": [0]}])
+    metrics = MetricsRegistry()
+    pool = WorkerPool(num_workers=2, mode="thread", faults=inj,
+                      metrics=metrics)
+    x = _toy_features(5000, 4)
+    with pytest.raises(WorkerCrashError) as ei:
+        pool.map_rows(lambda rows: rows * 2, x, out_width=4,
+                      chunk_rows=1000)
+    assert ei.value.chunk_index == 2
+    assert metrics.get("data.worker_failures") >= 1
+    # the injector's history IS the reproducibility witness
+    assert ("data.worker.chunk2", 0, "crash") in inj.schedule()
+
+
+def test_worker_crash_propagates_from_process_pool():
+    # an EXPLICITLY passed injector must fire inside spawned workers too
+    # (its (seed, rules) spec ships to the child; per-site streams are
+    # seed-derived, so the child fires the same schedule)
+    inj = FaultInjector(seed=5, rules=[
+        {"site": "data.worker.chunk1", "kind": "crash", "at": [0]}])
+    metrics = MetricsRegistry()
+    pool = WorkerPool(num_workers=2, mode="process", faults=inj,
+                      metrics=metrics)
+    x = _toy_features(6000, 4)
+    mapper = binning.fit_bins(x, max_bin=31)
+    import functools
+    from mmlspark_tpu.data.pipeline import _bin_rows
+    with pytest.raises(WorkerCrashError) as ei:
+        pool.map_rows(functools.partial(_bin_rows, mapper), x, out_width=4,
+                      out_dtype=np.uint8, chunk_rows=2000)
+    assert ei.value.chunk_index == 1
+    assert "InjectedCrash" in str(ei.value)
+    assert metrics.get("data.worker_failures") >= 1
+
+
+def test_worker_crash_propagates_through_staged_feed():
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "data.worker.chunk1", "kind": "error", "at": [0]}])
+    x = _toy_features(6000, 4)
+    mapper = binning.fit_bins(x, max_bin=31)
+    with pytest.raises(WorkerCrashError):
+        stage_binned(mapper, x, IngestOptions(num_workers=2,
+                                              chunk_rows=2000), faults=inj)
+
+
+def test_seeded_crash_schedule_is_reproducible():
+    rules = [{"site": "data.worker.chunk*", "kind": "error", "prob": 0.5}]
+    histories = []
+    for _ in range(2):
+        inj = FaultInjector(seed=13, rules=rules)
+        pool = WorkerPool(num_workers=3, mode="thread", faults=inj,
+                          metrics=MetricsRegistry())
+        try:
+            pool.map_rows(lambda r: r, _toy_features(4000, 3), out_width=3,
+                          chunk_rows=500)
+        except WorkerCrashError:
+            pass
+        histories.append(sorted(inj.schedule()))
+    assert histories[0] == histories[1] and histories[0]
+
+
+# -- overlap -----------------------------------------------------------------
+
+def test_prefetch_keeps_consumer_unstarved():
+    """Producer is 2x faster than the consumer: after the first batch the
+    consumer must never find the queue empty (the overlap smoke test)."""
+    metrics = MetricsRegistry()
+
+    def slow_put(item):
+        time.sleep(0.01)
+        return item
+
+    pf = DevicePrefetcher(range(10), depth=2, put=slow_put, metrics=metrics)
+    n = 0
+    for _ in pf:
+        time.sleep(0.025)   # consumer strictly slower than producer
+        n += 1
+    assert n == 10
+    # cold-start and sentinel waits don't count; a starved consumer would
+    # log ~10 mid-stream stalls, a healthy overlap logs none
+    assert metrics.get("data.prefetch.stalls") <= 1, \
+        metrics.snapshot()
+    assert metrics.get("data.prefetch.full") >= 1   # backpressure engaged
+
+
+def test_overlapped_feed_runs_producer_and_consumer_concurrently():
+    """Wall-clock smoke: producer 10 x 10ms + consumer 10 x 10ms overlapped
+    must take well under the 200ms serial sum."""
+    def produce():
+        for i in range(10):
+            time.sleep(0.01)
+            yield i
+
+    t0 = time.perf_counter()
+    for _ in DevicePrefetcher(produce(), depth=2, put=lambda x: x,
+                              metrics=MetricsRegistry()):
+        time.sleep(0.01)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.18, elapsed   # serial would be >= 0.20
+
+
+# -- LM stream feed ----------------------------------------------------------
+
+def test_lm_run_stream_matches_stepwise_feed():
+    from mmlspark_tpu.models.dnn.lm_training import ShardedLMTrainer
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+               for _ in range(3)]
+    kw = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+              max_len=32, seed=0)
+    t_ref = ShardedLMTrainer(**kw)
+    ref = [t_ref.step(b) for b in batches]
+    t_pf = ShardedLMTrainer(**kw)
+    got = t_pf.run_stream(iter(batches), prefetch=2)
+    assert np.allclose(got, ref, rtol=1e-6), (got, ref)
