@@ -4,7 +4,8 @@ from .http import (HTTPTransformer, SimpleHTTPTransformer, JSONInputParser,
                    JSONOutputParser, StringOutputParser, CustomInputParser,
                    CustomOutputParser, PartitionConsolidator, HTTPRequest,
                    HTTPResponse)
-from .serving import ServingServer, serve_pipeline, ServingQuery
+from .serving import Reply, ServingServer, serve_pipeline, ServingQuery
+from .plan import ServingTransform, compile_serving_transform
 from .streaming import FileStreamQuery, FileStreamSource
 from .registry import (RegistryClient, ServiceInfo, ServiceRegistry,
                        list_services, report_server_to_registry,
@@ -16,6 +17,7 @@ __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
            "JSONOutputParser", "StringOutputParser", "CustomInputParser",
            "CustomOutputParser", "PartitionConsolidator", "HTTPRequest",
            "HTTPResponse", "ServingServer", "serve_pipeline", "ServingQuery",
+           "Reply", "ServingTransform", "compile_serving_transform",
            "RegistryClient", "ServiceInfo", "ServiceRegistry",
            "list_services", "report_server_to_registry",
            "start_distributed_serving",

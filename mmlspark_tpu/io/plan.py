@@ -1,0 +1,280 @@
+"""Compiled inference plans: the serving fast path.
+
+The pre-PR `serve_pipeline` transform paid, per batch: a Python JSON parse
+per row, a fresh Table construction, an UNCOMPILED `model.transform` (with
+usage-event logging), and a per-row dict + `json.dumps` on the reply side.
+"Booster: An Accelerator for Gradient Boosting Decision Trees" (PAPERS.md)
+makes the point that tree scoring is sub-microsecond-per-row once the hot
+loop is prebuilt and batched — everything around the loop is the cost. This
+module removes it:
+
+- `pipeline_fingerprint(stage)`: stable digest of a fitted stage's class,
+  params, and fitted-state array shapes. Plans are keyed on
+  (fingerprint, shape bucket) — self-describing keys that stay
+  collision-free if the cache ever outlives one served model (shared
+  process-level cache, hot-swap).
+- shape buckets (`stages.batching.shape_bucket`): request batches pad to
+  power-of-two row counts, so jitted DNN/linear stages see a logarithmic
+  number of distinct shapes and stop recompiling per batch size. Repeated
+  same-bucket batches are cache HITS — `serving.plan.hits` /
+  `serving.plan.misses` counters (and `ServingTransform.stats()`) expose
+  the zero-recompile invariant to tests.
+- GBDT models skip Table/transform entirely: `Booster.scoring_plan` (a
+  prebuilt vectorized numpy descent — no per-request device dispatch) plus
+  the objective's output map, resolved once via `_serving_kernel`.
+- one columnar decode per batch on the way in (per-row try/except: a
+  malformed JSON body answers 400 ALONE, batch-mates stay on the fast
+  path), preserialized reply framing on the way out (the
+  `{"<output_col>": ` prefix is encoded once per server, not per request).
+
+Reference analog: Spark Serving pins one compiled pipeline per executor
+(HTTPSourceV2.scala WorkerServer); the plan cache is that, made explicit
+and observable.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..core import PipelineModel, Table
+from ..core.params import Params
+from ..reliability.metrics import reliability_metrics
+from ..stages.batching import pad_rows_to_bucket, shape_bucket
+from .serving import Reply, _jsonable
+
+
+def pipeline_fingerprint(stage) -> str:
+    """Stable hex digest of a (possibly nested) fitted stage: class,
+    non-transient params, and fitted-state array shapes/dtypes. Cheap by
+    design — array CONTENTS are not hashed. Within one `ServingTransform`
+    the model is fixed, so this mainly makes cache keys self-describing;
+    it is what lets plan keys stay collision-free if the cache is ever
+    shared across transforms/processes or a served model is hot-swapped."""
+    h = hashlib.sha1()
+
+    def feed(s):
+        h.update(type(s).__module__.encode())
+        h.update(type(s).__name__.encode())
+        if isinstance(s, Params):
+            for name, p in sorted(s.params().items()):
+                if p.transient:
+                    continue
+                v = s.get_or_default(name)
+                if isinstance(v, (list, tuple)) and any(
+                        isinstance(e, Params) for e in v):
+                    h.update(f"{name}:[{len(v)}]".encode())
+                    for e in v:
+                        feed(e)
+                else:
+                    h.update(f"{name}={v!r};".encode())
+        state = getattr(s, "_get_state", lambda: {})()
+        for k in sorted(state):
+            v = state[k]
+            if isinstance(v, np.ndarray):
+                h.update(f"{k}:{v.dtype}{v.shape};".encode())
+            else:
+                h.update(f"{k}={v!r};".encode())
+    feed(stage)
+    return h.hexdigest()
+
+
+def _decode_rows(bodies: Sequence[bytes], input_cols: Sequence[str]):
+    """Per-row JSON decode with per-row failure isolation: returns
+    (rows, replies) where rows[i] is the parsed dict or None, and
+    replies[i] is a 400 `Reply` for the rows that failed — a malformed
+    body answers immediately instead of poisoning its whole batch through
+    the MAX_REPLAYS replay machinery."""
+    rows: list = [None] * len(bodies)
+    replies: list = [None] * len(bodies)
+    for i, b in enumerate(bodies):
+        try:
+            row = json.loads(b)
+            if not isinstance(row, dict):
+                raise ValueError("body must be a JSON object")
+            for c in input_cols:
+                if c not in row:
+                    raise KeyError(f"missing input column {c!r}")
+        except (ValueError, KeyError, TypeError) as e:
+            msg = e.args[0] if isinstance(e, KeyError) and e.args else str(e)
+            replies[i] = Reply({"error": f"bad request: {msg}"}, status=400)
+            continue
+        rows[i] = row
+    return rows, replies
+
+
+class ServingTransform:
+    """The compiled `bodies -> replies` transform `serve_pipeline` mounts.
+
+    Holds the per-(fingerprint, shape-bucket) plan cache. Worker threads
+    share it: the dict lookup is lock-guarded but plans themselves are
+    stateless closures, so the lock covers nanoseconds — partitions scale
+    without a per-partition copy while jax's jit cache (process-global
+    anyway) still sees one stable shape per bucket."""
+
+    def __init__(self, model, input_cols: Sequence[str],
+                 output_col: str = "prediction", max_bucket: int = 4096,
+                 metrics=None):
+        # a single-stage PipelineModel serves through its one stage — the
+        # wrapper adds nothing and would hide the stage's serving kernel
+        stages = (model.get_or_default("stages")
+                  if isinstance(model, PipelineModel) else None)
+        self.model = stages[0] if stages is not None and len(stages) == 1 \
+            else model
+        self.input_cols = list(input_cols)
+        self.output_col = output_col
+        self.max_bucket = max_bucket
+        self._metrics = metrics if metrics is not None else reliability_metrics
+        self.fingerprint = pipeline_fingerprint(self.model)
+        # the row kernel consumes ONE features matrix; multi-column inputs
+        # go through the generic Table path
+        kernel_of = getattr(self.model, "_serving_kernel", None)
+        self._kernel = (kernel_of(output_col)
+                        if kernel_of is not None and len(self.input_cols) == 1
+                        else None)
+        self._plans: dict = {}
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        # reply framing serialized once: the write path appends only the
+        # per-row value between these fragments
+        self._prefix = ('{"%s": ' % output_col).encode()
+        self._suffix = b"}"
+
+    # -- plan construction ---------------------------------------------------
+    # A plan is an (assemble, run) pair: `assemble` converts parsed rows to
+    # arrays — everything that can fail there is CLIENT data (ragged row,
+    # wrong type/width) and maps to a per-row 400; `run` executes the model
+    # — failures there are server-side and propagate to the worker's
+    # replay/502 machinery, never misreported as the client's fault.
+    def _build_plan(self, bucket: int):
+        cols = self.input_cols
+        if self._kernel is not None:
+            kernel = self._kernel
+            col = cols[0]
+            width = getattr(kernel, "expected_features", None)
+
+            def assemble(rows: list) -> np.ndarray:
+                x = np.asarray([r[col] for r in rows], dtype=np.float32)
+                if x.ndim != 2 or (width is not None and x.shape[1] != width):
+                    raise ValueError(
+                        f"column {col!r} must be (n, {width}) numeric "
+                        f"vectors, got shape {x.shape}")
+                return x
+
+            # vectorized host kernel: shape-agnostic numpy, no padding
+            # needed — the bucket key only serves the hit accounting
+            return assemble, kernel
+
+        model, out_col = self.model, self.output_col
+
+        def assemble(rows: list) -> dict:
+            data = {}
+            for c in cols:
+                arr = np.asarray([r[c] for r in rows])
+                if arr.dtype == object:
+                    raise ValueError(
+                        f"column {c!r} holds ragged or mixed-type rows")
+                data[c] = arr
+            return data
+
+        def run(data: dict) -> np.ndarray:
+            n = next(iter(data.values())).shape[0]
+            padded = {c: pad_rows_to_bucket(a, bucket)
+                      for c, a in data.items()}
+            out = model.transform(Table(padded))
+            return np.asarray(out[out_col])[:n]
+        return assemble, run
+
+    def _plan_for(self, n_rows: int) -> tuple:
+        bucket = shape_bucket(n_rows, self.max_bucket)
+        key = (self.fingerprint, bucket)
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._hits += 1
+        if plan is not None:
+            self._metrics.inc("serving.plan.hits")
+            return plan
+        built = self._build_plan(bucket)
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is None:
+                plan = self._plans[key] = built
+                self._misses += 1
+                missed = True
+            else:
+                self._hits += 1   # another partition's worker built it first
+                missed = False
+        self._metrics.inc("serving.plan.misses" if missed
+                          else "serving.plan.hits")
+        return plan
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "buckets": len(self._plans)}
+
+    # -- the transform -------------------------------------------------------
+    def __call__(self, bodies: Sequence[bytes]) -> list:
+        rows, replies = _decode_rows(bodies, self.input_cols)
+        good_idx = [i for i, r in enumerate(rows) if r is not None]
+        if good_idx:
+            good_rows = [rows[i] for i in good_idx]
+            assemble, run = self._plan_for(len(good_rows))
+            try:
+                data = assemble(good_rows)
+            except (ValueError, TypeError) as batch_err:
+                # a parseable body with a BAD VALUE (ragged vector, wrong
+                # type/width) breaks the columnar assembly — find the
+                # offender(s) per row, 400 them, and run the model ONCE on
+                # the survivors so batch-mates stay on the fast path
+                survivors = []
+                for i, row in zip(good_idx, good_rows):
+                    try:
+                        assemble([row])
+                        survivors.append((i, row))
+                    except (ValueError, TypeError) as e:
+                        replies[i] = Reply({"error": f"bad request: {e}"},
+                                           status=400)
+                if not survivors:
+                    return replies
+                good_idx = [i for i, _ in survivors]
+                data = assemble([row for _, row in survivors])
+                del batch_err
+            # model execution: exceptions here are SERVER faults and
+            # propagate to the worker's replay/502 machinery untouched
+            vals = np.asarray(run(data))
+            prefix, suffix = self._prefix, self._suffix
+            if vals.ndim == 1 and vals.dtype.kind == "f":
+                # scalar-float fast path: Python float repr IS shortest
+                # round-trip JSON for finite values — skips json.dumps per
+                # row; non-finite falls back to json.dumps (NaN/Infinity,
+                # the same non-strict tokens the legacy path emitted)
+                for i, v in zip(good_idx, vals.tolist()):
+                    enc = (repr(v) if math.isfinite(v)
+                           else json.dumps(v)).encode()
+                    replies[i] = Reply(prefix + enc + suffix,
+                                       content_type="application/json")
+            else:
+                for i, v in zip(good_idx, vals):
+                    replies[i] = self._encode(v)
+        return replies
+
+    def _encode(self, v) -> Reply:
+        return Reply(
+            self._prefix + json.dumps(_jsonable(v)).encode() + self._suffix,
+            content_type="application/json")
+
+
+def compile_serving_transform(model, input_cols: Sequence[str],
+                              output_col: str = "prediction",
+                              max_bucket: int = 4096) -> ServingTransform:
+    """Build the compiled serving transform for a fitted model/pipeline.
+    See module docstring; `serve_pipeline(fast_path=True)` calls this."""
+    return ServingTransform(model, input_cols, output_col,
+                            max_bucket=max_bucket)

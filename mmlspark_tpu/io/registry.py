@@ -18,12 +18,14 @@ Composition (see `start_distributed_serving`):
 """
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler
 from typing import NamedTuple, Optional
 
+from ..reliability.policy import RetryPolicy
 from .serving import _ThreadingServer
 
 
@@ -104,6 +106,11 @@ class ServiceRegistry:
     def stop(self):
         self._httpd.shutdown()
         self._httpd.server_close()
+        # shutdown() returns once serve_forever exits, but the thread may
+        # still be unwinding — join so tests don't leak daemon threads
+        # between scenarios
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
 
     @property
     def address(self) -> str:
@@ -127,18 +134,43 @@ class ServiceRegistry:
 def report_server_to_registry(registry_address: str, name: str, host: str,
                               port: int, process_id: int = 0,
                               num_partitions: int = 1,
-                              timeout: float = 10.0) -> None:
+                              timeout: float = 10.0,
+                              retry_policy: Optional[RetryPolicy] = None) -> None:
     """Worker-side report (WorkerClient.reportServerToDriver,
-    HTTPSourceV2.scala:460-468)."""
+    HTTPSourceV2.scala:460-468).
+
+    Connection failures retry with jittered backoff under `timeout` as the
+    overall deadline (reliability.RetryPolicy): a worker that comes up
+    before the leader's registry is listening keeps trying instead of
+    failing registration permanently. An HTTP error status does NOT retry
+    — the registry answered and said no."""
+    policy = retry_policy if retry_policy is not None else RetryPolicy(
+        max_attempts=32, backoff=0.05, backoff_factor=2.0, max_backoff=1.0,
+        jitter=0.25, deadline=timeout,
+        metric_name="registry.report_retries")
     info = ServiceInfo(name=name, host=host, port=port,
                        process_id=process_id, num_partitions=num_partitions)
-    req = urllib.request.Request(
-        registry_address + "/register",
-        data=json.dumps(info._asdict()).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        if resp.status != 200:
-            raise RuntimeError(f"registry refused registration: {resp.status}")
+    data = json.dumps(info._asdict()).encode()
+    last_err: Optional[Exception] = None
+    for att in policy.attempts():
+        req = urllib.request.Request(
+            registry_address + "/register", data=data,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=att.timeout(5.0) or 5.0) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"registry refused registration: {resp.status}")
+                return
+        except urllib.error.HTTPError:
+            raise   # a real answer from a live registry; retrying can't help
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            last_err = e
+            att.retry()
+    raise RuntimeError(
+        f"registry registration failed after retries: {last_err}") \
+        from last_err
 
 
 def list_services(registry_address: str, name: str,
@@ -151,7 +183,17 @@ def list_services(registry_address: str, name: str,
 class RegistryClient:
     """Round-robin client over every registered server of a service — the
     load-balancer role the reference's ServiceInfo export feeds. Dead
-    servers drop out of rotation (and are retried on the next refresh)."""
+    servers drop out of rotation (and are retried on the next refresh).
+
+    Connections are POOLED keep-alive `http.client` sockets, one per
+    (thread, server): the pre-overhaul urllib path paid a fresh TCP
+    handshake per post — at serving rates that handshake dominates the
+    request itself. Pools are thread-local so concurrent callers never
+    serialize on a shared socket; dead-server eviction is shared. A reused
+    socket the server idle-closed between posts gets ONE transparent
+    reconnect to the same server before the failure counts against it
+    (at-least-once semantics, same as the failover re-execution the
+    rotation already implies)."""
 
     _MAX_ATTEMPTS = 16  # failover ceiling per post()
 
@@ -165,7 +207,59 @@ class RegistryClient:
         self._targets: list = []
         self._dead: set = set()
         self._count = 0
+        self._local = threading.local()   # per-thread address -> conn
         self.refresh()
+
+    # -- connection pool -----------------------------------------------------
+    def _pool(self) -> dict:
+        pool = getattr(self._local, "pool", None)
+        if pool is None:
+            pool = self._local.pool = {}
+        return pool
+
+    def _conn_for(self, t: ServiceInfo):
+        pool = self._pool()
+        conn = pool.get(t.address)
+        if conn is None:
+            conn = pool[t.address] = http.client.HTTPConnection(
+                t.host, t.port, timeout=self.timeout)
+        return conn
+
+    def _drop_conn(self, address: str) -> None:
+        conn = self._pool().pop(address, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Close THIS thread's pooled connections (each thread owns its
+        pool; sockets also die with the process — daemon client threads
+        need no explicit close)."""
+        pool = self._pool()
+        for addr in list(pool):
+            self._drop_conn(addr)
+
+    def _post_target(self, t: ServiceInfo, path: str, body: bytes,
+                     content_type: str):
+        """One POST over the pooled connection. A failure on a REUSED
+        socket (stale keep-alive: the server closed it between posts)
+        retries once on a fresh connection to the same server; a fresh
+        connection's failure propagates to the failover loop."""
+        for _ in range(2):
+            conn = self._conn_for(t)
+            reused = conn.sock is not None
+            try:
+                conn.request("POST", path, body=body,
+                             headers={"Content-Type": content_type})
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._drop_conn(t.address)
+                if not reused:
+                    raise
+        raise ConnectionError("unreachable")  # loop always returns/raises
 
     def refresh(self):
         targets = list_services(self.registry_address, self.name,
@@ -186,10 +280,11 @@ class RegistryClient:
 
     def post(self, body: bytes, path: str = "/",
              content_type: str = "application/json"):
-        """POST to the next live server. Only CONNECTION failures fail the
-        server over — an HTTP error status (e.g. serving's row-level 502) is
-        a real answer from a healthy server and is returned as-is; failing
-        over on it would re-execute the request elsewhere."""
+        """POST to the next live server over its pooled keep-alive
+        connection. Only CONNECTION failures fail the server over — an
+        HTTP error status (e.g. serving's row-level 502) is a real answer
+        from a healthy server and is returned as-is; failing over on it
+        would re-execute the request elsewhere."""
         if self._count and self._count % self._refresh_every == 0:
             try:
                 self.refresh()
@@ -216,17 +311,9 @@ class RegistryClient:
                 t = self._next_target()
                 if t is None:
                     break
-            req = urllib.request.Request(
-                t.address + path, data=body,
-                headers={"Content-Type": content_type}, method="POST")
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return r.status, r.read()
-            except urllib.error.HTTPError as e:
-                # HTTPError subclasses URLError — catch it FIRST: the server
-                # answered, it just said no
-                return e.code, e.read()
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                return self._post_target(t, path, body, content_type)
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
                 last_err = e
                 with self._lock:
                     self._dead.add(t.address)

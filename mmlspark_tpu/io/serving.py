@@ -31,14 +31,13 @@ from __future__ import annotations
 import collections
 import itertools
 import json
-import queue
 import selectors
 import socket
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
@@ -47,21 +46,40 @@ from ..reliability.faults import FaultInjector, InjectedCrash
 from ..reliability.metrics import reliability_metrics
 
 
+class Reply(NamedTuple):
+    """A transform's per-row answer with explicit status/content-type —
+    lets a transform 400 one malformed row (or return preserialized JSON
+    bytes) without touching its batch-mates. Plain dict/str/bytes replies
+    keep working; this is the typed superset the fast path (io/plan.py)
+    emits."""
+    data: object
+    status: int = 200
+    content_type: Optional[str] = None
+
+
+# request-id source: a process-unique counter under a random run prefix.
+# uuid4 per exchange costs ~2 us of entropy the ingress hot path doesn't
+# need — routing only requires per-process uniqueness
+_REQ_PREFIX = uuid.uuid4().hex[:8]
+_REQ_IDS = itertools.count()
+
+
 class CachedRequest:
     """One held HTTP exchange (reference: CachedRequest, HTTPSourceV2.scala:519)."""
 
     __slots__ = ("id", "body", "headers", "path", "_event", "_response",
-                 "_on_respond")
+                 "_on_respond", "t_enqueue")
 
     def __init__(self, body: bytes, headers: dict, path: str,
                  on_respond=None):
-        self.id = uuid.uuid4().hex
+        self.id = f"{_REQ_PREFIX}-{next(_REQ_IDS)}"
         self.body = body
         self.headers = headers
         self.path = path
         self._event = threading.Event()
         self._response: Optional[tuple] = None
         self._on_respond = on_respond   # selector transport wakeup
+        self.t_enqueue = 0.0            # stamped by ServingServer._enqueue
 
     def respond(self, status: int, body: bytes,
                 content_type: str = "application/json"):
@@ -128,6 +146,21 @@ _REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
 # memory grown without bound) by one misbehaving client.
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 64 * 1024 * 1024
+
+# (status, content_type) -> preencoded response-line + Content-Type header:
+# the write path's f-string + .encode per response was measurable at
+# 5k req/s; the handful of distinct pairs is cached forever
+_HDR_CACHE: dict = {}
+
+
+def _response_head(status: int, ctype: str) -> bytes:
+    head = _HDR_CACHE.get((status, ctype))
+    if head is None:
+        head = _HDR_CACHE[(status, ctype)] = (
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
+            f"Content-Type: {ctype}\r\nContent-Length: "
+        ).encode("latin-1")
+    return head
 
 
 class _SelectorConn:
@@ -378,12 +411,8 @@ class _SelectorServer:
             req = conn.inflight.popleft()
             self._deadlines.pop(req.id, None)
             status, payload, ctype = req._response
-            reason = _REASONS.get(status, "OK")
-            out.append(
-                (f"HTTP/1.1 {status} {reason}\r\n"
-                 f"Content-Type: {ctype}\r\n"
-                 f"Content-Length: {len(payload)}\r\n\r\n"
-                 ).encode("latin-1"))
+            out.append(_response_head(status, ctype))
+            out.append(b"%d\r\n\r\n" % len(payload))
             out.append(payload)
         if out:
             conn.wbuf += b"".join(out)
@@ -463,6 +492,59 @@ class _SelectorServer:
         self._sel.close()
 
 
+class _PartitionQueue:
+    """Condition-variable request queue with latency-budget coalescing.
+
+    Replaces the fixed-poll `queue.Queue` drain: a worker blocked in
+    `drain()` is woken the instant `put()` lands — an idle partition adds
+    ZERO polling latency to the first request (reference: the continuous
+    WorkerServer path hands requests straight to the pinned pipeline;
+    CTA-Pipelining's case for explicit admission control over fixed
+    polling, PAPERS.md). After the first request, `linger_s` is the
+    latency budget: the drain coalesces whatever else arrives within it
+    (up to max_rows) instead of either returning a batch of one or
+    sleeping a fixed poll interval."""
+
+    __slots__ = ("_items", "_cond")
+
+    def __init__(self):
+        self._items = collections.deque()
+        self._cond = threading.Condition()
+
+    def put(self, req) -> None:
+        with self._cond:
+            self._items.append(req)
+            self._cond.notify()
+
+    def qsize(self) -> int:
+        return len(self._items)   # racy read: load-shed bound, not invariant
+
+    def drain(self, max_rows: int, idle_timeout: float,
+              linger_s: float = 0.0) -> list:
+        """Up to max_rows requests: block at most idle_timeout for the
+        first, then coalesce arrivals within linger_s. linger_s=0 takes
+        exactly what is already queued (continuous/drain-available)."""
+        batch: list = []
+        with self._cond:
+            if not self._items:
+                self._cond.wait(idle_timeout)
+                if not self._items:
+                    return batch
+            while self._items and len(batch) < max_rows:
+                batch.append(self._items.popleft())
+            if linger_s > 0.0 and len(batch) < max_rows:
+                deadline = time.monotonic() + linger_s
+                while len(batch) < max_rows:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0.0:
+                        break
+                    if not self._items:
+                        self._cond.wait(remaining)
+                    while self._items and len(batch) < max_rows:
+                        batch.append(self._items.popleft())
+        return batch
+
+
 class ServingServer:
     """Per-host HTTP ingress with N logical partitions and epoch replay
     (reference: WorkerServer + HTTPSourceStateHolder, HTTPSourceV2.scala)."""
@@ -483,7 +565,7 @@ class ServingServer:
         # falls back to the MMLSPARK_TPU_FAULTS env spec
         self._faults = faults if faults is not None else FaultInjector.from_env()
         self._draining = False
-        self._queues = [queue.Queue() for _ in range(num_partitions)]
+        self._queues = [_PartitionQueue() for _ in range(num_partitions)]
         self._rr = itertools.count()
         # (partition, epoch) -> list[CachedRequest]; GC'd on commit
         self._history: dict = {}
@@ -554,17 +636,28 @@ class ServingServer:
             reliability_metrics.inc("serving.shed_requests")
             req.respond(503, b'{"error": "overloaded"}')
             return
+        req.t_enqueue = time.perf_counter()
         with self._lock:
             self._routing[req.id] = req
-        self._queues[pid].put(req)
+        q = self._queues[pid]
+        q.put(req)
+        reliability_metrics.set_gauge("serving.queue_depth", q.qsize())
 
     # -- source API (per-partition readers) ---------------------------------
     def get_batch(self, pid: int, max_rows: int = 64,
-                  timeout: float = 0.05) -> tuple:
+                  timeout: float = 0.05, linger: float = 0.0) -> tuple:
         """Drain up to max_rows requests for partition pid; returns
         (epoch, [CachedRequest]). Replayed batches take priority — a worker
         re-registering at an uncommitted epoch sees the same data again
-        (reference: registerPartition recovery, HTTPSourceV2.scala:488-505)."""
+        (reference: registerPartition recovery, HTTPSourceV2.scala:488-505).
+
+        `timeout` bounds the idle wait for the FIRST request (the worker
+        loop's stop-flag check cadence); the wakeup itself is a condition
+        variable, not a poll. `linger` is the coalescing latency budget in
+        SECONDS: once one request is in hand, arrivals within the budget
+        join the batch up to max_rows (0.0 = take only what is already
+        queued — continuous mode's batch-of-1 takes the first request
+        immediately either way)."""
         with self._lock:
             epoch = self._epochs[pid]
             cached = self._history.get((pid, epoch))
@@ -572,13 +665,14 @@ class ServingServer:
             # filter requests already answered (client may have timed out)
             alive = [r for r in cached if not r._event.is_set()]
             return epoch, alive
-        batch = []
-        try:
-            batch.append(self._queues[pid].get(timeout=timeout))
-            while len(batch) < max_rows:
-                batch.append(self._queues[pid].get_nowait())
-        except queue.Empty:
-            pass
+        batch = self._queues[pid].drain(max_rows, timeout, linger)
+        if batch:
+            now = time.perf_counter()
+            # one registry lookup per batch (NOT per request); the handle is
+            # never cached across calls so tests' reset() stays effective
+            hist = reliability_metrics.histogram("serving.request.queue")
+            for r in batch:
+                hist.observe_ms((now - r.t_enqueue) * 1000.0)
         with self._lock:
             self._history[(pid, epoch)] = batch
         return epoch, batch
@@ -592,8 +686,12 @@ class ServingServer:
             self._epochs[pid] = epoch + 1
 
     # -- sink API -----------------------------------------------------------
-    def reply_to(self, request_id: str, data, status: int = 200):
-        """Route a response to the held exchange (HTTPSourceV2.scala:535-553)."""
+    def reply_to(self, request_id: str, data, status: int = 200,
+                 content_type: Optional[str] = None):
+        """Route a response to the held exchange (HTTPSourceV2.scala:535-553).
+        `content_type` overrides the type inferred from `data` — the fast
+        path hands over preserialized JSON bytes and must not label them
+        octet-stream."""
         with self._lock:
             req = self._routing.get(request_id)
         if req is None:
@@ -604,7 +702,7 @@ class ServingServer:
             payload, ctype = data.encode(), "text/plain"
         else:
             payload, ctype = json.dumps(_jsonable(data)).encode(), "application/json"
-        req.respond(status, payload, ctype)
+        req.respond(status, payload, content_type or ctype)
         return True
 
 
@@ -627,15 +725,24 @@ class ServingQuery:
 
     def __init__(self, server: ServingServer, transform_fn: Callable,
                  mode: str = "microbatch", max_batch: int = 64,
-                 poll_timeout: float = 0.02,
+                 poll_timeout: float = 0.02, batch_linger_ms: float = 0.0,
                  faults: Optional[FaultInjector] = None,
                  watchdog_interval: float = 0.02):
         if mode not in ("microbatch", "continuous"):
             raise ValueError("mode must be microbatch|continuous")
+        if batch_linger_ms < 0:
+            raise ValueError("batch_linger_ms must be >= 0")
         self.server = server
         self.transform_fn = transform_fn
         self.max_batch = 1 if mode == "continuous" else max_batch
         self.poll_timeout = poll_timeout
+        # coalescing latency budget: 0 drains only what is already queued
+        # (and continuous mode's batch-of-1 never lingers — the first
+        # request dispatches immediately); >0 trades that much tail
+        # latency for batch occupancy under load (docs/serving.md
+        # "Latency tuning")
+        self.batch_linger_ms = 0.0 if mode == "continuous" \
+            else float(batch_linger_ms)
         self.watchdog_interval = watchdog_interval
         # share the server's injector by default: one seed, one schedule
         self._faults = faults if faults is not None else server._faults
@@ -681,7 +788,8 @@ class ServingQuery:
             batch: list = []
             try:
                 epoch, batch = self.server.get_batch(
-                    pid, self.max_batch, timeout=self.poll_timeout)
+                    pid, self.max_batch, timeout=self.poll_timeout,
+                    linger=self.batch_linger_ms / 1000.0)
                 if pid in self._inject and batch:
                     # die between read and commit — the worst spot: requests
                     # are in flight. History must replay them to the next
@@ -726,7 +834,7 @@ class ServingQuery:
                             continue  # already answered (expired to 504)
                         try:
                             reply = self.transform_fn([r.body])[0]
-                            self.server.reply_to(r.id, reply)
+                            self._reply_one(r, reply)
                         except Exception as row_e:  # noqa: BLE001
                             self.server.reply_to(r.id, {"error": str(row_e)},
                                                  status=502)
@@ -737,16 +845,38 @@ class ServingQuery:
                     # brief backoff so a failing loop doesn't hot-spin
                     time.sleep(0.01 * replays)
 
+    def _reply_one(self, r, reply):
+        if isinstance(reply, Reply):
+            self.server.reply_to(r.id, reply.data, status=reply.status,
+                                 content_type=reply.content_type)
+        else:
+            self.server.reply_to(r.id, reply)
+
     def _process(self, pid: int, epoch: int, batch: list):
         # skip exchanges already answered (expired to 504 by the transport):
         # the transform would be wasted compute into a dead socket
         live = [r for r in batch if not r._event.is_set()]
         if not live:
             return
+        reliability_metrics.set_gauge("serving.batch.occupancy",
+                                      len(live) / max(self.max_batch, 1))
         bodies = [r.body for r in live]
+        t0 = time.perf_counter()
         replies = self.transform_fn(bodies)
+        t1 = time.perf_counter()
         for r, reply in zip(live, replies):
-            self.server.reply_to(r.id, reply)
+            self._reply_one(r, reply)
+        t2 = time.perf_counter()
+        # stage latencies: transform/reply are per-BATCH (every request in
+        # the batch experienced them); e2e is per request from ingress
+        # enqueue to routed response
+        reliability_metrics.observe_ms("serving.request.transform",
+                                       (t1 - t0) * 1000.0)
+        reliability_metrics.observe_ms("serving.request.reply",
+                                       (t2 - t1) * 1000.0)
+        hist = reliability_metrics.histogram("serving.request.e2e")
+        for r in live:
+            hist.observe_ms((t2 - r.t_enqueue) * 1000.0)
 
     def stop(self):
         self._stop.set()
@@ -765,7 +895,8 @@ class ServingQuery:
 def serve_pipeline(model, input_cols, output_col: str = "prediction",
                    host: str = "127.0.0.1", port: int = 0,
                    num_partitions: int = 1, mode: str = "microbatch",
-                   max_batch: int = 64):
+                   max_batch: int = 64, batch_linger_ms: float = 0.0,
+                   fast_path: bool = True):
     """One-call serving of a fitted PipelineModel: JSON rows in, scored
     column out (reference: the readStream.server().load() ->
     pipeline -> writeStream.server() composition, IOImplicits.scala).
@@ -773,17 +904,29 @@ def serve_pipeline(model, input_cols, output_col: str = "prediction",
     Each request body is a JSON object {col: value, ...}; the reply is
     {output_col: value}. Returns (server, query); stop with query.stop() +
     server.stop().
-    """
+
+    `fast_path=True` (default) mounts the compiled-inference transform
+    (io/plan.py): per-(fingerprint, shape-bucket) cached plans, prebuilt
+    GBDT host scoring, one columnar decode per batch, per-row 400s for
+    malformed JSON, preserialized reply framing. `fast_path=False` keeps
+    the uncached Table-per-batch path — the pre-overhaul baseline
+    BENCH_MODE=serving measures against. `batch_linger_ms` is the
+    microbatch coalescing budget (docs/serving.md "Latency tuning")."""
     server = ServingServer(host, port, num_partitions).start()
 
-    def transform(bodies: list) -> list:
-        rows = [json.loads(b) for b in bodies]
-        cols = {}
-        for c in input_cols:
-            cols[c] = np.asarray([row[c] for row in rows])
-        out = model.transform(Table(cols))
-        vals = np.asarray(out[output_col])
-        return [{output_col: _jsonable(v)} for v in vals]
+    if fast_path:
+        from .plan import compile_serving_transform
+        transform = compile_serving_transform(model, input_cols, output_col)
+    else:
+        def transform(bodies: list) -> list:
+            rows = [json.loads(b) for b in bodies]
+            cols = {}
+            for c in input_cols:
+                cols[c] = np.asarray([row[c] for row in rows])
+            out = model.transform(Table(cols))
+            vals = np.asarray(out[output_col])
+            return [{output_col: _jsonable(v)} for v in vals]
 
-    q = ServingQuery(server, transform, mode=mode, max_batch=max_batch).start()
+    q = ServingQuery(server, transform, mode=mode, max_batch=max_batch,
+                     batch_linger_ms=batch_linger_ms).start()
     return server, q

@@ -331,6 +331,21 @@ class _GBDTModelBase(Model, HasFeaturesCol, HasPredictionCol):
         with open(path, "w") as f:
             f.write(json.dumps(payload))
 
+    def _serving_kernel(self, output_col: str):
+        """Vectorized `(n, F) -> values` closure for the serving fast path
+        (io/plan.py): scoring without Table construction or the transform
+        telemetry, on the booster's prebuilt host plan. Returns None when
+        `output_col` isn't one this model can compute standalone — the
+        caller falls back to the generic bucketed `transform` plan."""
+        return None
+
+    def _stamp_kernel(self, fn):
+        """Annotate a kernel with the feature width the serving decode
+        validates against (a wrong-width request 400s at assembly instead
+        of reaching the scorer)."""
+        fn.expected_features = self._booster.n_features
+        return fn
+
     def _maybe_extra_cols(self, t: Table, x) -> Table:
         lcol = self.get("leaf_prediction_col") if self.has_param("leaf_prediction_col") else None
         if lcol:
@@ -382,20 +397,46 @@ class GBDTClassificationModel(_GBDTModelBase, HasProbabilitiesCol):
     n_classes = Param("n_classes", "number of classes", 2)
     sigmoid = Param("sigmoid", "sigmoid scale", 1.0)
 
+    def _proba_from_raw(self, raw: np.ndarray) -> np.ndarray:
+        """Raw margins -> class probabilities — the ONE copy of the
+        objective's output map, shared by the batch transform and the
+        serving kernel so the two paths can never drift."""
+        if self._booster.objective == "multiclass":
+            e = np.exp(raw - raw.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        p1 = 1.0 / (1.0 + np.exp(-self.sigmoid * raw[:, 0]))
+        return np.stack([1 - p1, p1], axis=1)
+
     def _transform(self, t: Table) -> Table:
         x = np.asarray(t[self.features_col], np.float32)
         raw = self._booster.raw_score(x, self._init_score)
-        if self._booster.objective == "multiclass":
-            e = np.exp(raw - raw.max(axis=1, keepdims=True))
-            proba = e / e.sum(axis=1, keepdims=True)
-        else:
-            p1 = 1.0 / (1.0 + np.exp(-self.sigmoid * raw[:, 0]))
-            proba = np.stack([1 - p1, p1], axis=1)
+        proba = self._proba_from_raw(raw)
         pred = proba.argmax(axis=1).astype(np.float64)
         t = (t.with_column(self.raw_prediction_col, raw)
               .with_column(self.probabilities_col, proba)
               .with_column(self.prediction_col, pred))
         return self._maybe_extra_cols(t, x)
+
+    def _serving_kernel(self, output_col: str):
+        multiclass = self._booster.objective == "multiclass"
+        if output_col == self.prediction_col:
+            plan = self._booster.scoring_plan(self._init_score)
+            if multiclass:
+                # softmax is monotonic: argmax(proba) == argmax(raw),
+                # including ties (both pick the first maximum)
+                kern = lambda x: plan(x).argmax(axis=1).astype(np.float64)
+            else:
+                # argmax([1-p1, p1]) == 1 iff p1 > 0.5 iff raw > 0
+                kern = lambda x: (plan(x)[:, 0] > 0).astype(np.float64)
+            return self._stamp_kernel(kern)
+        if output_col == self.raw_prediction_col:
+            return self._stamp_kernel(
+                self._booster.scoring_plan(self._init_score))
+        if output_col == self.probabilities_col:
+            plan = self._booster.scoring_plan(self._init_score)
+            return self._stamp_kernel(
+                lambda x: self._proba_from_raw(plan(x)))
+        return None
 
 
 class GBDTRegressor(Estimator, _GBDTParams):
@@ -419,13 +460,23 @@ class GBDTRegressionModel(_GBDTModelBase):
     leaf_prediction_col = Param("leaf_prediction_col", "leaf index output col", None)
     features_shap_col = Param("features_shap_col", "SHAP output col", None)
 
+    def _link(self, raw: np.ndarray) -> np.ndarray:
+        """Margin -> prediction link (one copy for transform + kernel)."""
+        if self._booster.objective in ("poisson", "tweedie"):
+            raw = np.exp(raw)
+        return raw.astype(np.float64)
+
     def _transform(self, t: Table) -> Table:
         x = np.asarray(t[self.features_col], np.float32)
         raw = self._booster.raw_score(x, self._init_score)[:, 0]
-        if self._booster.objective in ("poisson", "tweedie"):
-            raw = np.exp(raw)
-        t = t.with_column(self.prediction_col, raw.astype(np.float64))
+        t = t.with_column(self.prediction_col, self._link(raw))
         return self._maybe_extra_cols(t, x)
+
+    def _serving_kernel(self, output_col: str):
+        if output_col != self.prediction_col:
+            return None
+        plan = self._booster.scoring_plan(self._init_score)
+        return self._stamp_kernel(lambda x: self._link(plan(x)[:, 0]))
 
 
 class GBDTRanker(Estimator, _GBDTParams):
@@ -454,6 +505,13 @@ class GBDTRankerModel(_GBDTModelBase):
         raw = self._booster.raw_score(x, self._init_score)[:, 0]
         t = t.with_column(self.prediction_col, raw.astype(np.float64))
         return self._maybe_extra_cols(t, x)
+
+    def _serving_kernel(self, output_col: str):
+        if output_col != self.prediction_col:
+            return None
+        plan = self._booster.scoring_plan(self._init_score)
+        return self._stamp_kernel(
+            lambda x: plan(x)[:, 0].astype(np.float64))
 
 
 def load_native_model(path: str, model_cls=GBDTRegressionModel):

@@ -4,7 +4,7 @@ breaking, recovery metrics, and a deterministic fault-injection harness
 HTTPv2Suite, unified and made seed-reproducible). See docs/reliability.md."""
 from .faults import (FAULTS_ENV, Fault, FaultInjector, InjectedCrash,
                      InjectedFault)
-from .metrics import Counter, MetricsRegistry, reliability_metrics
+from .metrics import Counter, Histogram, MetricsRegistry, reliability_metrics
 from .policy import (Attempt, CircuitBreaker, CircuitOpenError, Deadline,
                      RetryBudget, RetryPolicy)
 
@@ -12,4 +12,4 @@ __all__ = ["RetryPolicy", "RetryBudget", "Attempt", "CircuitBreaker",
            "CircuitOpenError", "Deadline",
            "FaultInjector", "Fault", "InjectedFault", "InjectedCrash",
            "FAULTS_ENV",
-           "MetricsRegistry", "Counter", "reliability_metrics"]
+           "MetricsRegistry", "Counter", "Histogram", "reliability_metrics"]

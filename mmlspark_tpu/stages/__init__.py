@@ -3,7 +3,8 @@ from .basic import (Cacher, DropColumns, Explode, Lambda, RenameColumn,
                     Repartition, SelectColumns, StratifiedRepartition,
                     UDFTransformer)
 from .batching import (DynamicMiniBatchTransformer, FixedMiniBatchTransformer,
-                       FlattenBatch, TimeIntervalMiniBatchTransformer)
+                       FlattenBatch, TimeIntervalMiniBatchTransformer,
+                       pad_rows_to_bucket, shape_bucket)
 from .ensemble import (ClassBalancer, ClassBalancerModel, EnsembleByKey,
                        MultiColumnAdapter)
 from .summarize import SummarizeData
@@ -17,5 +18,6 @@ __all__ = [
     "MultiColumnAdapter", "RenameColumn", "Repartition", "SelectColumns",
     "StratifiedRepartition", "SummarizeData", "TextPreprocessor",
     "TimeIntervalMiniBatchTransformer", "Timer", "TimerModel",
-    "UDFTransformer", "UnicodeNormalize",
+    "UDFTransformer", "UnicodeNormalize", "pad_rows_to_bucket",
+    "shape_bucket",
 ]

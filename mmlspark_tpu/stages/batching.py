@@ -24,6 +24,29 @@ def _stack_rows(col: np.ndarray, bounds) -> np.ndarray:
     return out
 
 
+def shape_bucket(n: int, max_bucket: int = 1 << 20) -> int:
+    """Smallest power-of-two >= n (capped): the row-count bucket jitted
+    stages compile against. Padding request batches to these buckets keeps
+    the number of distinct compiled shapes logarithmic in max batch size —
+    the serving plan cache (io/plan.py) keys compiled transforms on it."""
+    if n < 1:
+        return 1
+    return min(1 << (n - 1).bit_length(), max_bucket)
+
+
+def pad_rows_to_bucket(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad a row-major array to `bucket` rows by repeating the final row.
+    Repeating real data (not zeros) keeps padding inside the numeric range
+    every row-wise stage already handles — no log(0)/divide-by-zero
+    surprises from synthetic rows. Callers slice outputs back to the true
+    row count."""
+    n = arr.shape[0]
+    if n >= bucket:
+        return arr
+    pad = np.broadcast_to(arr[-1:], (bucket - n,) + arr.shape[1:])
+    return np.concatenate([arr, pad], axis=0)
+
+
 class _BatcherBase(Transformer):
     def _bounds(self, n: int) -> list:
         raise NotImplementedError
@@ -36,13 +59,36 @@ class _BatcherBase(Transformer):
 
 class FixedMiniBatchTransformer(_BatcherBase):
     """Fixed-size batches (reference: FixedMiniBatchTransformer; buffered
-    producer-thread mode is meaningless on a columnar Table and is omitted)."""
+    producer-thread mode is meaningless on a columnar Table and is omitted).
+
+    `pad_last_batch=True` pads the trailing ragged batch to the full
+    batch_size by repeating its final row — every batch then has one shape,
+    so a jitted downstream stage compiles exactly once (the same
+    shape-stability contract the serving plan cache enforces with
+    `shape_bucket`)."""
     batch_size = Param("batch_size", "rows per batch", 10,
                        validator=in_range(1))
+    pad_last_batch = Param("pad_last_batch",
+                           "pad the ragged final batch to batch_size by "
+                           "repeating its last row (shape-stable batches "
+                           "for jitted stages)", False)
 
     def _bounds(self, n: int) -> list:
         b = self.batch_size
         return [(i, min(i + b, n)) for i in range(0, n, b)]
+
+    def _transform(self, t: Table) -> Table:
+        out = super()._transform(t)
+        if not self.pad_last_batch:
+            return out
+        data = {}
+        for name in out.columns:
+            col = out[name]
+            if len(col) and col[-1].shape[0] < self.batch_size:
+                col = col.copy()
+                col[-1] = pad_rows_to_bucket(col[-1], self.batch_size)
+            data[name] = col
+        return Table(data, out.npartitions)
 
 
 class DynamicMiniBatchTransformer(_BatcherBase):

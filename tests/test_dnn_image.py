@@ -37,6 +37,30 @@ def test_fixed_minibatch_and_flatten():
     fuzz_transformer(FlattenBatch(), batched)
 
 
+def test_fixed_minibatch_pad_last_batch():
+    """pad_last_batch: the ragged final batch fills to batch_size by
+    repeating its last row — every batch one shape (the serving plan
+    cache's shape-stability contract, stages.batching.shape_bucket)."""
+    t = Table({"x": np.arange(25).astype(np.float32)})
+    out = FixedMiniBatchTransformer(batch_size=10,
+                                    pad_last_batch=True).transform(t)
+    assert [b.shape for b in out["x"]] == [(10,), (10,), (10,)]
+    np.testing.assert_array_equal(out["x"][2][:5], np.arange(20, 25))
+    np.testing.assert_array_equal(out["x"][2][5:], np.full(5, 24.0))
+
+
+def test_shape_bucket_and_pad_helpers():
+    from mmlspark_tpu.stages import pad_rows_to_bucket, shape_bucket
+    assert [shape_bucket(n) for n in (0, 1, 2, 3, 4, 5, 63, 64, 65)] == \
+        [1, 1, 2, 4, 4, 8, 64, 64, 128]
+    assert shape_bucket(10**9, max_bucket=4096) == 4096
+    a = np.arange(6, dtype=np.float32).reshape(3, 2)
+    p = pad_rows_to_bucket(a, 4)
+    assert p.shape == (4, 2)
+    np.testing.assert_array_equal(p[3], a[2])     # repeats the last row
+    assert pad_rows_to_bucket(a, 3) is a          # no-op when full
+
+
 def test_dynamic_minibatch():
     t = Table({"x": np.arange(10).astype(np.float32)})
     out = DynamicMiniBatchTransformer().transform(t)

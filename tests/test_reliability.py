@@ -224,6 +224,64 @@ def test_metrics_registry_counters_and_wall_clock_sink():
     assert reg.snapshot() == {}
 
 
+def test_histogram_percentiles_bounded_error():
+    """Geometric buckets promise bounded RELATIVE quantile error: for a
+    known uniform sample, each reported percentile must land within one
+    bucket ratio (~9%) of the exact value, and percentiles must be
+    monotonic in p."""
+    from mmlspark_tpu.reliability.metrics import _HIST_RATIO, Histogram
+    h = Histogram("t")
+    vals = [float(i) for i in range(1, 1001)]   # 1..1000 ms uniform
+    for v in vals:
+        h.observe_ms(v)
+    assert h.count == 1000
+    prev = 0.0
+    for p in (10, 50, 90, 95, 99, 100):
+        exact = vals[int(len(vals) * p / 100) - 1]
+        got = h.percentile(p)
+        assert got >= prev, (p, got, prev)
+        assert exact / _HIST_RATIO <= got <= exact * _HIST_RATIO, (p, got,
+                                                                   exact)
+        prev = got
+    snap = h.snapshot()
+    assert snap["count"] == 1000
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    assert abs(snap["mean_ms"] - 500.5) < 1e-6
+
+
+def test_histogram_edge_cases():
+    from mmlspark_tpu.reliability.metrics import Histogram
+    h = Histogram("t")
+    assert h.percentile(50) == 0.0          # empty
+    h.observe_ms(-5.0)                      # clamped to 0, never raises
+    h.observe_ms(0.0)
+    h.observe_ms(1e9)                       # beyond top bound -> last bucket
+    assert h.count == 3
+    assert h.percentile(100) == 1e9         # clamped to observed max
+    h2 = Histogram("t2")
+    h2.observe(0.025)                       # seconds-flavored sink
+    assert 20.0 <= h2.percentile(50) <= 30.0
+
+
+def test_registry_histograms_and_gauges_in_snapshot():
+    reg = MetricsRegistry()
+    for ms in (1.0, 2.0, 4.0, 100.0):
+        reg.observe_ms("svc.lat", ms)
+    reg.set_gauge("svc.depth", 7)
+    snap = reg.snapshot()
+    assert snap["svc.lat.count"] == 4
+    assert snap["svc.lat.p50"] <= snap["svc.lat.p95"] <= snap["svc.lat.p99"]
+    assert snap["svc.depth"] == 7.0
+    assert reg.gauge("svc.depth") == 7.0
+    assert reg.percentile("svc.lat", 50) == snap["svc.lat.p50"]
+    assert reg.percentile("absent", 50) == 0.0
+    reg.reset(prefix="svc.")
+    assert reg.snapshot() == {}
+    # reset() must detach old handles: a fresh observe starts from zero
+    reg.observe_ms("svc.lat", 3.0)
+    assert reg.snapshot()["svc.lat.count"] == 1
+
+
 # ---------------------------------------------------------------- faults
 @pytest.mark.chaos
 def test_fault_injector_same_seed_same_schedule():

@@ -4,6 +4,8 @@ Single-process coverage here; the real 2-process composition (leader
 registry + per-process servers + worker-kill replay) lives in
 tests/test_multiprocess.py::test_distributed_serving_two_processes."""
 import json
+import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -109,6 +111,116 @@ def test_http_error_returned_not_failed_over(registry):
     finally:
         q.stop()
         s1.stop()
+
+
+def test_failover_evicts_dead_server_from_rotation(registry):
+    """A killed server must be EVICTED from rotation after its first
+    connection failure — every subsequent post routes to survivors without
+    re-dialing the corpse (pre-overhaul only the happy path pinned this)."""
+    s1 = ServingServer(num_partitions=1).start()
+    s2 = ServingServer(num_partitions=1).start()
+    q1 = _echo_query(s1, "a")
+    q2 = _echo_query(s2, "b")
+    for s in (s1, s2):
+        host, port = s._httpd.server_address[:2]
+        report_server_to_registry(registry.address, "evict", host, port)
+    client = RegistryClient(registry.address, "evict")
+    dead_addr = f"http://{s2._httpd.server_address[0]}" \
+                f":{s2._httpd.server_address[1]}"
+    # prime both rotations, then kill b
+    for i in range(4):
+        assert client.post(json.dumps({"x": i}).encode())[0] == 200
+    q2.stop()
+    s2.stop()
+    try:
+        tags = []
+        for i in range(8):
+            status, body = client.post(json.dumps({"x": i}).encode())
+            assert status == 200
+            tags.append(json.loads(body)["tag"])
+        assert set(tags) == {"a"}          # survivors carry all traffic
+        assert dead_addr in client._dead   # the corpse left the rotation
+    finally:
+        q1.stop()
+        s1.stop()
+
+
+def test_client_pools_keepalive_connections(registry):
+    """post() must reuse ONE pooled connection per (thread, server) — the
+    keep-alive contract replacing the per-request urllib handshake — and
+    transparently reconnect when the server idle-closes the socket."""
+    s1 = ServingServer(num_partitions=1).start()
+    q1 = _echo_query(s1, "ka")
+    host, port = s1._httpd.server_address[:2]
+    report_server_to_registry(registry.address, "ka", host, port)
+    client = RegistryClient(registry.address, "ka")
+    try:
+        for i in range(6):
+            status, _ = client.post(json.dumps({"x": i}).encode())
+            assert status == 200
+        pool = client._pool()
+        assert len(pool) == 1              # one connection, six posts
+        conn = next(iter(pool.values()))
+        assert conn.sock is not None       # still open (keep-alive held)
+        # server closes the socket under the client: the next post must
+        # reconnect to the SAME server, not fail over or error out
+        conn.sock.close()
+        status, body = client.post(json.dumps({"x": 99}).encode())
+        assert status == 200 and json.loads(body)["echo"] == 99
+        client.close()
+        assert not client._pool()
+    finally:
+        q1.stop()
+        s1.stop()
+
+
+def test_report_retries_until_registry_up():
+    """Satellite: a worker that starts BEFORE the registry is listening
+    must keep retrying under its deadline and succeed once the registry
+    binds — not fail registration permanently."""
+    import socket as _socket
+    from mmlspark_tpu.reliability import RetryPolicy
+    # reserve a port, hold it CLOSED for the first attempts
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    reg_holder = {}
+
+    def late_start():
+        time.sleep(0.3)
+        reg_holder["reg"] = ServiceRegistry(port=port).start()
+
+    th = threading.Thread(target=late_start)
+    th.start()
+    try:
+        report_server_to_registry(
+            f"http://127.0.0.1:{port}", "late", "127.0.0.1", 7100,
+            retry_policy=RetryPolicy(max_attempts=64, backoff=0.05,
+                                     jitter=0.2, deadline=10.0))
+        th.join()
+        svcs = list_services(f"http://127.0.0.1:{port}", "late")
+        assert [s.port for s in svcs] == [7100]
+    finally:
+        th.join()
+        if "reg" in reg_holder:
+            reg_holder["reg"].stop()
+
+
+def test_report_gives_up_at_deadline():
+    from mmlspark_tpu.reliability import RetryPolicy
+    with pytest.raises(RuntimeError, match="after retries"):
+        report_server_to_registry(
+            "http://127.0.0.1:9", "ghost", "127.0.0.1", 7000,
+            retry_policy=RetryPolicy(max_attempts=3, backoff=0.01,
+                                     deadline=1.0))
+
+
+def test_registry_stop_joins_thread():
+    reg = ServiceRegistry().start()
+    th = reg._thread
+    reg.stop()
+    assert not th.is_alive()   # no leaked daemon thread between scenarios
 
 
 def test_no_live_servers_is_clear_error(registry):
