@@ -74,38 +74,45 @@ def _run_chunk(fn: Callable, x: np.ndarray, out: np.ndarray, chunk: Chunk,
 
 def _process_worker(fn_bytes: bytes, in_name: str, in_shape, in_dtype: str,
                     out_name: str, out_shape, out_dtype: str,
-                    chunks, result_q, fault_spec) -> None:
+                    chunks, result_q, fault_spec, prog_name) -> None:
     """Child entry: attach both shared-memory buffers, run this worker's
     chunk set, write results in place. EVERY chunk reports a
     (chunk_index, traceback-or-None) marker — the parent requires a marker
     per chunk, so a lost/unreported chunk can never pass off uninitialized
-    output as success. Errors travel as formatted tracebacks, never raw
-    exception objects (whose pickling can itself fail). `fault_spec` is the
-    parent pool's injector as (seed, rules) — an explicitly-passed
-    FaultInjector must keep firing in process mode, not just env-activated
-    ones (per-site streams are seed-derived, so the child's schedule is the
-    same one the parent would have fired)."""
+    output as success. Completed chunks ALSO flip a per-chunk byte in the
+    `prog_name` shared-memory progress buffer: the queue marker rides a
+    feeder thread a SIGKILL can race, while the memory write is immediate —
+    so a worker killed by signal mid-chunk is blamed for the chunk it was
+    actually in, deterministically, not for whichever earlier markers the
+    dying feeder failed to flush. Errors travel as formatted tracebacks,
+    never raw exception objects (whose pickling can itself fail).
+    `fault_spec` is the parent pool's injector as (seed, rules) — an
+    explicitly-passed FaultInjector must keep firing in process mode, not
+    just env-activated ones (per-site streams are seed-derived, so the
+    child's schedule is the same one the parent would have fired)."""
     from multiprocessing import shared_memory
-    shm_in = shm_out = None
+    shm_in = shm_out = shm_prog = None
     try:
         fn = pickle.loads(fn_bytes)
         faults = (FaultInjector(seed=fault_spec[0], rules=fault_spec[1])
                   if fault_spec is not None else FaultInjector.from_env())
         shm_in = shared_memory.SharedMemory(name=in_name)
         shm_out = shared_memory.SharedMemory(name=out_name)
+        shm_prog = shared_memory.SharedMemory(name=prog_name)
         x = np.ndarray(in_shape, dtype=np.dtype(in_dtype), buffer=shm_in.buf)
         out = np.ndarray(out_shape, dtype=np.dtype(out_dtype),
                          buffer=shm_out.buf)
         for index, lo, hi in chunks:
             try:
                 _run_chunk(fn, x, out, Chunk(index, lo, hi), faults)
+                shm_prog.buf[index] = 1   # durable before the queue marker
                 result_q.put((index, None))
             except BaseException:  # noqa: BLE001 - report, keep going
                 result_q.put((index, traceback.format_exc(limit=8)))
     except BaseException:  # noqa: BLE001 - setup failure: blame chunk -1
         result_q.put((-1, traceback.format_exc(limit=8)))
     finally:
-        for shm in (shm_in, shm_out):
+        for shm in (shm_in, shm_out, shm_prog):
             if shm is not None:
                 try:
                     shm.close()
@@ -214,6 +221,11 @@ class WorkerPool:
         shm_in = shared_memory.SharedMemory(create=True, size=max(x.nbytes, 1))
         shm_out = shared_memory.SharedMemory(create=True,
                                              size=max(out.nbytes, 1))
+        # one completion byte per chunk, written by workers the instant a
+        # chunk's output rows land — survives a SIGKILL that would eat the
+        # queue feeder's unflushed markers (see _process_worker)
+        shm_prog = shared_memory.SharedMemory(create=True, size=len(chunks))
+        shm_prog.buf[:len(chunks)] = bytes(len(chunks))
         procs = []
         try:
             np.ndarray(x.shape, x.dtype, buffer=shm_in.buf)[...] = x
@@ -231,7 +243,7 @@ class WorkerPool:
                     target=_process_worker,
                     args=(fn_bytes, shm_in.name, x.shape, x.dtype.str,
                           shm_out.name, out.shape, out.dtype.str, plan,
-                          result_q, fault_spec),
+                          result_q, fault_spec, shm_prog.name),
                     daemon=True)
                 p.start()
                 procs.append(p)
@@ -280,10 +292,17 @@ class WorkerPool:
             dead = [p for p in procs if p.exitcode not in (0, None)]
             if len(done) < len(chunks) and not errors:
                 missing = sorted(set(c.index for c in chunks) - set(done))
-                code = dead[0].exitcode if dead else "unknown"
-                errors[missing[0]] = (f"worker process died (exitcode "
-                                      f"{code}) before reporting chunks "
-                                      f"{missing}")
+                # credit chunks whose shared-memory completion byte landed
+                # even though the dying feeder ate their queue marker: the
+                # output rows ARE in the buffer, and the FIRST chunk the
+                # killed worker never completed becomes the deterministic
+                # blame index (mid-chunk signal kills included)
+                missing = [i for i in missing if shm_prog.buf[i] == 0]
+                if missing:
+                    code = dead[0].exitcode if dead else "unknown"
+                    errors[missing[0]] = (f"worker process died (exitcode "
+                                          f"{code}) before reporting chunks "
+                                          f"{missing}")
             if errors:
                 index = min(errors)
                 self.metrics.inc("data.worker_failures", len(errors))
@@ -293,7 +312,7 @@ class WorkerPool:
             for p in procs:
                 if p.is_alive():
                     p.terminate()
-            for shm in (shm_in, shm_out):
+            for shm in (shm_in, shm_out, shm_prog):
                 try:
                     shm.close()
                     shm.unlink()

@@ -345,7 +345,8 @@ def start_distributed_serving(transform_fn, name: str = "serving",
                               num_partitions: int = 1,
                               mode: str = "microbatch",
                               registry_port: int = 0,
-                              advertise_host=None):
+                              advertise_host=None,
+                              drain_on_sigterm: bool = False):
     """Every process of the jax.distributed job serves; the leader also runs
     the registry. Returns (registry_or_None, server, query, registry_address)
     — registry is non-None only on process 0.
@@ -386,5 +387,11 @@ def start_distributed_serving(transform_fn, name: str = "serving",
     s_port = server._httpd.server_address[1]
     report_server_to_registry(registry_address, name, pub_host, s_port,
                               process_id=pid, num_partitions=num_partitions)
+    if drain_on_sigterm:
+        # preempted hosts answer their in-flight requests before exiting
+        # (serving.drain_on_signal; the leader also takes its registry down)
+        from .serving import drain_on_signal
+        drain_on_signal(servers=[server], queries=[query],
+                        registries=[registry] if registry else [])
     cluster.barrier(f"serving_up_{name}")
     return registry, server, query, registry_address

@@ -930,3 +930,51 @@ def serve_pipeline(model, input_cols, output_col: str = "prediction",
     q = ServingQuery(server, transform, mode=mode, max_batch=max_batch,
                      batch_linger_ms=batch_linger_ms).start()
     return server, q
+
+
+def drain_on_signal(servers=(), queries=(), registries=(),
+                    signals=None, exit_code: int = 0,
+                    drain_timeout: float = 5.0):
+    """Route SIGTERM (host preemption) through the graceful drain path.
+
+    Previously only an explicit `stop()` drained; a preempted serving host
+    died with in-flight requests unanswered. This installs a handler that,
+    on SIGTERM/SIGINT: refuses new connections and 503s new requests on
+    every server while in-flight exchanges are ANSWERED and flushed
+    (`ServingServer.stop(drain=True)`), then stops the queries and
+    registries, and finally exits with `exit_code` (SystemExit; pass
+    `exit_code=None` to keep the process alive). Counted under
+    `serving.signal_drains`. Must be called from the main thread; returns
+    the handler so tests can invoke it directly.
+    """
+    import signal as _signal
+    servers, queries = tuple(servers), tuple(queries)
+    registries = tuple(registries)
+    if signals is None:
+        signals = (_signal.SIGTERM, _signal.SIGINT)
+
+    def _handler(signum=_signal.SIGTERM, frame=None):
+        reliability_metrics.inc("serving.signal_drains")
+        # order matters: servers drain FIRST (workers must still be alive
+        # to answer the in-flight requests), then queries, then registries
+        for s in servers:
+            try:
+                s.stop(drain=True, drain_timeout=drain_timeout)
+            except Exception:  # noqa: BLE001 - drain the rest regardless
+                pass
+        for q in queries:
+            try:
+                q.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        for r in registries:
+            try:
+                r.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if exit_code is not None:
+            raise SystemExit(exit_code)
+
+    for sig in signals:
+        _signal.signal(sig, _handler)
+    return _handler

@@ -46,19 +46,22 @@ def _param_shardings(params: dict, mesh):
     }
 
 
-def _build_multi_step(step_fn, donate):
+def _build_multi_step(step_fn, donate, out_shardings=None):
     """Jitted (params, opt_state, tok, n) -> (params, opt_state, last
     loss): n optimizer steps as a device-side fori_loop with n as a
     TRACED bound — one executable serves every chunk size (a static
     count would recompile the full program per distinct n). Shared by
     ShardedLMTrainer.run and PipelinedLMTrainer.run; step_fn is the
-    UN-jitted single step so donation applies once, at this boundary."""
+    UN-jitted single step so donation applies once, at this boundary.
+    `out_shardings` pins outputs to the canonical layout (see
+    ShardedLMTrainer's single-executable contract)."""
     import functools
 
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, donate_argnums=donate)
+    @functools.partial(jax.jit, donate_argnums=donate,
+                       out_shardings=out_shardings)
     def multi(params, opt_state, tok, n):
         def body(_, carry):
             p, o, _l = carry
@@ -132,6 +135,18 @@ class ShardedLMTrainer:
             is_leaf=lambda x: isinstance(x, np.ndarray))
         self._opt = optax.adam(lr)
         self.opt_state = self._opt.init(self.params)
+        # optax init leaves its step-count scalar UNCOMMITTED while every
+        # jitted step returns it committed replicated-on-mesh — two
+        # different executables (cache keys differ), whose reduction
+        # orders need not agree. Committing it replicated here makes the
+        # first step, every later step, AND a checkpoint-restored step all
+        # hit ONE executable — the precondition for bit-deterministic
+        # crash-resume (lm_state_from_payload places restored leaves the
+        # same way).
+        rep = NamedSharding(mesh, P())
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a: a if getattr(a, "committed", True)
+            else jax.device_put(a, rep), self.opt_state)
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
 
         opt = self._opt
@@ -153,9 +168,23 @@ class ShardedLMTrainer:
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
+        # Single-executable contract: XLA's sharding propagation would
+        # otherwise emit step outputs in ITS preferred layout (e.g. embed
+        # resharded over the model axis), so the first step (constructor
+        # placements in) and every later step (jit outputs in) compile two
+        # different executables whose reduction orders need not agree —
+        # which costs bit-determinism of checkpoint-resume (a restored
+        # trainer replays on constructor-style placements). Pinning
+        # out_shardings to the canonical Megatron layout makes fresh,
+        # steady-state, and restored steps all hit ONE executable.
+        self._out_shardings = (
+            jax.tree_util.tree_map(lambda a: a.sharding, self.params),
+            jax.tree_util.tree_map(lambda a: a.sharding, self.opt_state),
+            NamedSharding(mesh, P()))
         # raw step kept for run()'s fori_loop body; jitted once here
         self._step_fn = train_step
-        self._step = jax.jit(train_step, donate_argnums=self._donate)
+        self._step = jax.jit(train_step, donate_argnums=self._donate,
+                             out_shardings=self._out_shardings)
         self._multi = None   # lazily-built multi-step executable (run())
 
     def _to_device(self, tokens):
@@ -182,21 +211,40 @@ class ShardedLMTrainer:
         if n_steps < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
         if self._multi is None:
-            self._multi = _build_multi_step(self._step_fn, self._donate)
+            self._multi = _build_multi_step(self._step_fn, self._donate,
+                                            self._out_shardings)
         self.params, self.opt_state, loss = self._multi(
             self.params, self.opt_state, self._to_device(tokens),
             jnp.asarray(n_steps, jnp.int32))
         return float(loss)
 
     def run_stream(self, batches, steps_per_batch: int = 1,
-                   prefetch: int = 2) -> list:
+                   prefetch: int = 2, checkpoint_dir: str = None,
+                   checkpoint_every: int = 10, resume: bool = True,
+                   **supervisor_kw) -> list:
         """Train over an iterable of host (B, S) token batches with the
         bounded ingest prefetcher (data.DevicePrefetcher): batch k+1 rides
         host->device transfer (and any upstream tokenize/load work the
         iterable does) WHILE batch k trains — the LM-side use of the
         parallel ingest pipeline's overlap contract. Returns the per-batch
         final losses; `steps_per_batch > 1` chains device-side steps per
-        batch through the same fori_loop executable run() uses."""
+        batch through the same fori_loop executable run() uses.
+
+        `checkpoint_dir` turns on fault-tolerant supervision
+        (reliability.TrainingSupervisor): params/opt-state are snapshotted
+        every `checkpoint_every` batches and written ASYNCHRONOUSLY (the
+        step thread never blocks on disk — though each snapshot still
+        pays a host gather of params+opt state, so size checkpoint_every
+        to your loss-tolerance, not to 1), SIGTERM/SIGINT trigger a final
+        synchronous checkpoint then raise `reliability.Preempted`, failed
+        steps restart from the last snapshot, and a killed run re-invoked
+        with `resume=True` (the default) continues from the newest
+        digest-valid checkpoint with BIT-IDENTICAL results to an
+        uninterrupted run (the batch cursor and loss history ride in the
+        payload). `batches` must then be a finite re-indexable sequence —
+        the resumed/rewound run replays from the cursor. Extra kwargs
+        (step_timeout, retry_policy, heartbeat, faults, ...) pass through
+        to TrainingSupervisor."""
         import operator
 
         import jax.numpy as jnp
@@ -205,22 +253,73 @@ class ShardedLMTrainer:
         if steps_per_batch < 1:
             raise ValueError(
                 f"steps_per_batch must be >= 1, got {steps_per_batch}")
-        losses = []
-        with DevicePrefetcher(batches, depth=prefetch,
-                              put=self._to_device) as pf:
-            for tok_dev in pf:
-                if steps_per_batch == 1:
-                    self.params, self.opt_state, loss = self._step(
-                        self.params, self.opt_state, tok_dev)
-                else:
-                    if self._multi is None:
-                        self._multi = _build_multi_step(self._step_fn,
-                                                        self._donate)
-                    self.params, self.opt_state, loss = self._multi(
-                        self.params, self.opt_state, tok_dev,
-                        jnp.asarray(steps_per_batch, jnp.int32))
-                losses.append(float(loss))
-        return losses
+
+        def one_batch(tok_dev):
+            if steps_per_batch == 1:
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, tok_dev)
+            else:
+                if self._multi is None:
+                    self._multi = _build_multi_step(self._step_fn,
+                                                    self._donate,
+                                                    self._out_shardings)
+                self.params, self.opt_state, loss = self._multi(
+                    self.params, self.opt_state, tok_dev,
+                    jnp.asarray(steps_per_batch, jnp.int32))
+            return float(loss)
+
+        if checkpoint_dir is None:
+            if supervisor_kw:
+                raise TypeError(
+                    f"supervisor options {sorted(supervisor_kw)} require "
+                    f"checkpoint_dir")
+            losses = []
+            with DevicePrefetcher(batches, depth=prefetch,
+                                  put=self._to_device) as pf:
+                for tok_dev in pf:
+                    losses.append(one_batch(tok_dev))
+            return losses
+
+        from ...reliability.supervisor import TrainingSupervisor
+        import jax
+        if jax.process_count() > 1:
+            # every process would race the same step dir (save_lm_checkpoint
+            # gates on the leader + barriers; the async writer has no such
+            # rendezvous yet) — refuse loudly rather than corrupt quietly
+            raise NotImplementedError(
+                "run_stream(checkpoint_dir=...) is single-process for now; "
+                "multi-host jobs should checkpoint via save_lm_checkpoint "
+                "(leader-only write + barrier)")
+        batches = list(batches)   # rewind/resume needs random access
+
+        def snapshot():
+            return lm_state_payload(self.params, self.opt_state, self.meta)
+
+        def restore(payload):
+            self.params, self.opt_state = lm_state_from_payload(
+                payload, self.params, self.opt_state, self.meta)
+
+        stream = {"pf": None, "it": None}
+
+        def seek(step):
+            if stream["pf"] is not None:
+                stream["pf"].close()
+            pf = DevicePrefetcher(batches[step:], depth=prefetch,
+                                  put=self._to_device)
+            stream["pf"], stream["it"] = pf, iter(pf)
+
+        def step_fn(step):
+            return one_batch(next(stream["it"]))
+
+        sup = TrainingSupervisor(checkpoint_dir, snapshot, restore,
+                                 checkpoint_every=checkpoint_every,
+                                 **supervisor_kw)
+        try:
+            return sup.run(step_fn, len(batches), seek=seek, resume=resume)
+        finally:
+            if stream["pf"] is not None:
+                stream["pf"].close()
+            sup.close()
 
     # -- checkpoint/resume --------------------------------------------------
     # The reference has nothing comparable (SURVEY §5: "no mid-training
@@ -242,16 +341,13 @@ class ShardedLMTrainer:
         return step
 
 
-def save_lm_checkpoint(directory: str, step: int, params, opt_state, meta,
-                       tag: str) -> None:
-    """Leader-only write of host-gathered leaves (shared by the GSPMD and
-    pipelined trainers — one implementation, one on-disk format)."""
+def lm_state_payload(params, opt_state, meta) -> dict:
+    """Host-gathered checkpoint payload of an LM trainer's live state (the
+    snapshot half of the shared on-disk format; multi-host gathers shards
+    so every leaf is addressable from the leader)."""
     import jax
-    from ...utils.checkpoint import CheckpointManager
     from .model import tree_to_payload
     if jax.process_count() > 1:
-        # multi-host: gather shards so every leaf is addressable, then
-        # write from the leader only (shared filesystem, one writer)
         from jax.experimental import multihost_utils
         params = multihost_utils.process_allgather(params, tiled=True)
         opt_state = multihost_utils.process_allgather(opt_state, tiled=True)
@@ -262,6 +358,16 @@ def save_lm_checkpoint(directory: str, step: int, params, opt_state, meta,
     # live optimizer state (same optimizer config = same structure)
     payload.update(tree_to_payload(params, "p"))
     payload.update(tree_to_payload(opt_state, "o", leaves_only=True))
+    return payload
+
+
+def save_lm_checkpoint(directory: str, step: int, params, opt_state, meta,
+                       tag: str) -> None:
+    """Leader-only write of host-gathered leaves (shared by the GSPMD and
+    pipelined trainers — one implementation, one on-disk format)."""
+    import jax
+    from ...utils.checkpoint import CheckpointManager
+    payload = lm_state_payload(params, opt_state, meta)
     if jax.process_index() == 0:
         CheckpointManager(directory).save(step, payload)
     if jax.process_count() > 1:
@@ -274,16 +380,27 @@ def restore_lm_checkpoint(directory: str, step, live_params, live_opt_state,
     """Returns (params, opt_state, step) with every leaf re-placed onto the
     LIVE state's shardings — works unchanged for GSPMD and pipelined
     layouts (the live leaves carry the layout)."""
-    import jax
-    import jax.numpy as jnp
     from ...utils.checkpoint import CheckpointManager
-    from .model import tree_from_payload
     mgr = CheckpointManager(directory)
     if step is None:
-        # resolve ONCE: the returned step must be the one actually
-        # loaded, even if a concurrent writer lands a newer step
-        step = mgr.latest_step()
-    payload = mgr.restore(step)
+        # latest mode rides restore's corrupt-step fallback (a torn or
+        # digest-mismatched newest step must cost one interval, not the
+        # run); with_step reports the step ACTUALLY loaded
+        payload, step = mgr.restore(with_step=True)
+    else:
+        payload = mgr.restore(step)
+    params, opt_state = lm_state_from_payload(payload, live_params,
+                                              live_opt_state, meta)
+    return params, opt_state, step
+
+
+def lm_state_from_payload(payload, live_params, live_opt_state, meta):
+    """Apply a checkpoint payload back onto live state: every leaf
+    re-placed with the LIVE leaves' shardings (the restore half of the
+    shared format; also the supervisor's `restore_fn` body)."""
+    import jax
+    import jax.numpy as jnp
+    from .model import tree_from_payload
     saved_meta = payload.get("meta")
     if saved_meta is not None and dict(saved_meta) != dict(meta):
         raise ValueError(
@@ -318,11 +435,28 @@ def restore_lm_checkpoint(directory: str, step, live_params, live_opt_state,
             f"checkpoint has {len(o_leaves)} optimizer leaves but this "
             f"trainer's optimizer expects {len(live_leaves)} — "
             f"optimizer config changed since the save")
-    # match each live leaf's placement; an UNCOMMITTED live leaf (fresh
-    # optax init scalars) must stay uncommitted — committing it to its
-    # current single device would conflict with the sharded params in jit
-    placed = [jax.device_put(a, live.sharding)
-              if getattr(live, "committed", False) else jnp.asarray(a)
-              for a, live in zip(o_leaves, live_leaves)]
+    # match each live leaf's placement. An UNCOMMITTED live leaf (fresh
+    # optax init scalars) must not be committed to its CURRENT single
+    # device (that conflicts with the sharded params in jit) — but leaving
+    # it uncommitted makes the resumed step compile a DIFFERENT executable
+    # than the one a continuously-running trainer uses (whose outputs are
+    # committed replicated-on-mesh), and different reduction orders cost
+    # bit-identity of crash-resume. Place it exactly where a jitted step
+    # would: replicated over the params' mesh.
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = next((lv.sharding.mesh for lv in live_p
+                 if isinstance(getattr(lv, "sharding", None), NamedSharding)),
+                None)
+    replicated = (NamedSharding(mesh, PartitionSpec())
+                  if mesh is not None else None)
+
+    def place(a, live):
+        if getattr(live, "committed", False):
+            return jax.device_put(a, live.sharding)
+        if replicated is not None:
+            return jax.device_put(a, replicated)
+        return jnp.asarray(a)
+
+    placed = [place(a, live) for a, live in zip(o_leaves, live_leaves)]
     opt_state = jax.tree_util.tree_unflatten(structure, placed)
-    return restored_params, opt_state, step
+    return restored_params, opt_state

@@ -382,7 +382,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 prebinned: Optional[tuple] = None,
                 presence: Optional[np.ndarray] = None,
                 checkpoint_fn=None, checkpoint_interval: int = 25,
-                init_base: float = 0.0, ingest=None):
+                init_base: float = 0.0, ingest=None,
+                init_margin: Optional[np.ndarray] = None,
+                init_rng_key: Optional[np.ndarray] = None,
+                iter_offset: int = 0):
     """Train a Booster on host arrays. Single-device by default; the
     distributed path (distributed.py) passes a shard_map-wrapped `tree_fn`
     and a sharding `put_fn`, and this same loop runs over the mesh.
@@ -395,6 +398,19 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
 
     Padded rows (distributed ragged handling) carry weight 0 and therefore
     contribute nothing to histograms, leaf values, or the init score.
+
+    Deterministic crash-resume (the supervisor contract, docs/reliability.md):
+    `checkpoint_fn(it, booster, base, final=, margin=, rng_key=)` receives
+    the LIVE training margin and the current PRNG key at each checkpoint;
+    a resumed fit passing them back as `init_margin`/`init_rng_key` (plus
+    `iter_offset` = completed iterations, so bagging phase lines up)
+    replays the remaining iterations on bit-identical state — the float
+    re-association of recomputing margins via `init_booster.raw_score`
+    would otherwise cost exact resume. Caveat: validation-metric state
+    (best_metric/patience, the incremental v_margin) is NOT checkpointed —
+    a run killed before an early stop triggers may resume to a different
+    stopping iteration (the stop decision restarts fresh); completed early
+    stops are final-marked and never retrained.
     """
     p = params
     cb = callbacks or Callbacks()
@@ -454,7 +470,9 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     elif p.boost_from_average and init_scores is None and not multiclass:
         base = obj_mod.init_score(p.objective, y, weights=weights)
     init_margin_arr = None
-    if init_booster is not None:
+    if init_booster is not None and init_margin is None:
+        # resumed-without-saved-margin (legacy checkpoints) / warm starts:
+        # rebuild the continuation margin by scoring the restored ensemble
         init_margin_arr = init_booster.raw_score(x)  # (n, K)
     margin_no_continuation = None  # rf: gradients target y, not residuals
     # margins are DEVICE-created: np.full/np.zeros here used to upload
@@ -484,6 +502,20 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         margin_no_continuation = margin
         if init_margin_arr is not None:
             margin = margin + put(init_margin_arr[:, 0].astype(np.float32))
+    if init_margin is not None:
+        # checkpointed live margin: REPLACES the reconstruction above so the
+        # resumed device state is bitwise the uninterrupted run's. A saved
+        # margin only makes sense against the SAME rows — pairing it with a
+        # regenerated dataset would silently train on wrong per-row scores
+        # (the pre-margin raw_score path at least recomputed against x)
+        init_margin = np.asarray(init_margin, np.float32)
+        if init_margin.shape[0] != n:
+            raise ValueError(
+                f"init_margin has {init_margin.shape[0]} rows but x has "
+                f"{n} — the checkpoint was saved against different data; "
+                f"delete the checkpoint dir (or drop init_margin) to "
+                f"restart from the restored trees alone")
+        margin = put(init_margin)
 
     # validation margins maintained incrementally on binned valid rows
     has_valid = valid is not None
@@ -516,7 +548,41 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     rf = p.boosting == "rf"
     dart = p.boosting == "dart"
     goss = p.boosting == "goss"
-    key = jax.random.PRNGKey(p.seed)
+    key = (jax.random.PRNGKey(p.seed) if init_rng_key is None
+           else jnp.asarray(np.asarray(init_rng_key, np.uint32)))
+    iter_offset = int(iter_offset)
+    if checkpoint_fn is not None:
+        # legacy checkpoint_fn signatures predate the margin/rng_key
+        # kwargs — only pass them to callbacks that can take them, so an
+        # external `lambda it, booster, base, final=False: ...` keeps
+        # working (it just loses exact-resume margins)
+        import inspect
+        try:
+            ck_params = inspect.signature(checkpoint_fn).parameters
+            _ck_extended = ("margin" in ck_params
+                            or any(q.kind == q.VAR_KEYWORD
+                                   for q in ck_params.values()))
+        except (TypeError, ValueError):
+            _ck_extended = True
+        _user_ck = checkpoint_fn
+        # multi-host: the margin is row-sharded over the GLOBAL mesh — not
+        # fully addressable from one process, so np.asarray would raise.
+        # Skip the exact-resume margin there (legacy raw_score resume
+        # still works); single-host sharded margins gather fine.
+        _margin_addressable = jax.process_count() == 1
+
+        def checkpoint_fn(it, booster, fit_base, final=False, margin=None,
+                          rng_key=None):
+            if not _margin_addressable:
+                margin = None
+            elif margin is not None:
+                margin = np.asarray(margin)
+            if rng_key is not None:
+                rng_key = np.asarray(rng_key)
+            if _ck_extended:
+                return _user_ck(it, booster, fit_base, final=final,
+                                margin=margin, rng_key=rng_key)
+            return _user_ck(it, booster, fit_base, final=final)
 
     # ---- fused path: whole boosting loop as chunked lax.scan (no host in
     # the loop). Host-loop fallback covers DART (needs per-tree delta
@@ -566,11 +632,15 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             (margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c,
              mts) = fused(
                 d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_, vy_j,
-                v_margin_, kc, it, p, cfg, clen, k_out, has_valid=has_valid)
+                v_margin_, kc, it + iter_offset, p, cfg, clen, k_out,
+                has_valid=has_valid)
             parts.append((sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c))
             if checkpoint_fn is not None:
                 # chunk boundary = natural checkpoint step: build the
-                # booster-so-far from the accumulated parts (host-cheap)
+                # booster-so-far from the accumulated parts (host-cheap).
+                # The live margin + PRNG key ride along so a resumed fit
+                # continues on bit-identical state (the snapshot D2H is the
+                # cheap host copy; the disk write may be async downstream)
                 _sf, _sb, _lv, _gn, _cv, _ic, _cw = _fetch_packed(parts)
                 _tc = np.tile(np.arange(k_out, dtype=np.int32),
                               _sf.shape[0] // max(k_out, 1))
@@ -578,7 +648,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                     _sf, _sb, _lv, _tc, mapper, p, k_out, n_features, -1,
                     init_booster, base, gain=_gn, cover=_cv, is_cat=_ic,
                     cat_words=_cw), base,
-                    final=False)
+                    final=False, margin=margin, rng_key=key)
             if track:
                 for i, mv in enumerate(np.asarray(mts)):
                     mv = float(mv)
@@ -668,8 +738,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             grad = grad * (w_j[:, None] if multiclass else w_j)
             hess = hess * (w_j[:, None] if multiclass else w_j)
 
-        # row sampling: bagging or GOSS (shared with the fused path)
-        row_w = _row_weights(p, grad, k_bag, it, multiclass)
+        # row sampling: bagging or GOSS (shared with the fused path);
+        # iter_offset keeps a resumed fit's bagging phase aligned with the
+        # absolute iteration the uninterrupted run would be at
+        row_w = _row_weights(p, grad, k_bag, it + iter_offset, multiclass)
         if row_w is not None:
             grad = grad * (row_w[:, None] if multiclass else row_w)
             hess = hess * (row_w[:, None] if multiclass else row_w)
@@ -781,7 +853,8 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             checkpoint_fn(it + 1, _build_booster(
                 _sf, _sb, _lv, np.asarray(tree_classes, np.int32), mapper, p,
                 k_out, n_features, -1, init_booster, base, gain=_gn,
-                cover=_cv, is_cat=_ic, cat_words=_cw), base, final=False)
+                cover=_cv, is_cat=_ic, cat_words=_cw), base, final=False,
+                margin=margin, rng_key=key)
 
     max_nodes = 2 ** (p.max_depth + 1) - 1
     T = len(trees)

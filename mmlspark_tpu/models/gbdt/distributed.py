@@ -91,7 +91,9 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
                             callbacks=None, parallelism: str = "data_parallel",
                             top_k: int = 20, num_tasks: int = 0,
                             checkpoint_fn=None, checkpoint_interval: int = 25,
-                            init_base: float = 0.0, ingest=None):
+                            init_base: float = 0.0, ingest=None,
+                            init_margin=None, init_rng_key=None,
+                            iter_offset: int = 0):
     """Same training loop as fit_booster, with rows sharded over the mesh.
 
     Split decisions are computed identically on every shard from the psum'd
@@ -147,5 +149,6 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
         tree_fn=tree_fn, put_fn=put_rows, chunk_fn=chunk_fn,
         presence=pres_p, checkpoint_fn=checkpoint_fn,
         checkpoint_interval=checkpoint_interval, init_base=init_base,
-        ingest=ingest)
+        ingest=ingest, init_margin=init_margin, init_rng_key=init_rng_key,
+        iter_offset=iter_offset)
     return booster, base, hist

@@ -120,10 +120,16 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     checkpoint_dir = Param(
         "checkpoint_dir",
         "step-checkpoint directory (utils.checkpoint.CheckpointManager); "
-        "fit() resumes from the latest step and saves every "
+        "fit() resumes from the latest digest-valid step and saves every "
         "checkpoint_interval iterations", None)
     checkpoint_interval = Param("checkpoint_interval",
                                 "iterations between checkpoints", 25)
+    checkpoint_async = Param(
+        "checkpoint_async",
+        "write periodic checkpoints on a background thread "
+        "(reliability.AsyncCheckpointWriter) so the boosting loop never "
+        "blocks on disk; the final/early-stop checkpoint stays synchronous",
+        True)
 
     def _boost_params(self, objective: str, num_class: int = 1) -> BoostParams:
         return BoostParams(
@@ -221,16 +227,25 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
 
         # step-level checkpoint/resume (SURVEY.md §5); single-batch fits only
         ck_fn, resume_booster, done, resume_base = None, None, 0, 0.0
+        resume_margin, resume_key, writer = None, None, None
         if self.checkpoint_dir and n_batches <= 1:
+            from ...reliability.supervisor import AsyncCheckpointWriter
             from ...utils.checkpoint import CheckpointManager
             from .booster import Booster as _B
             mgr = CheckpointManager(self.checkpoint_dir)
             latest = mgr.latest_step()
             if latest is not None:
-                payload = mgr.restore(latest)
+                # restore() (not restore(latest)): a torn or
+                # silently-corrupted newest step falls back to the
+                # next-newest digest-valid one instead of killing the fit
+                payload = mgr.restore()
                 resume_booster = _B.load_model_string(str(payload["booster"]))
                 done = int(payload["iteration"])
                 resume_base = float(payload.get("base", 0.0))
+                # live margin + PRNG key (absent in legacy checkpoints):
+                # with them the resumed fit replays on bit-identical state
+                resume_margin = payload.get("margin")
+                resume_key = payload.get("rng_key")
                 if payload.get("final"):
                     # training completed (possibly early-stopped): the
                     # checkpoint IS the final model
@@ -246,19 +261,35 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                     resume_booster = resume_booster._replace(
                         leaf_value=(resume_booster.leaf_value
                                     * (denom / total)).astype(np.float32))
+                    # rescaled trees invalidate the saved margin (it embeds
+                    # the old weights); fall back to raw_score continuation
+                    resume_margin = resume_key = None
             remaining = max(total - done, 0)
             # rf averaging weights must stay 1/TOTAL across the resume split
             params = dataclasses.replace(params, num_iterations=remaining,
                                          rf_total=total)
+            # periodic writes ride a background thread (the boosting loop
+            # never blocks on disk); the final/early-stop write is
+            # synchronous and prunes newer steps as before
+            writer = AsyncCheckpointWriter(mgr) if self.checkpoint_async \
+                else None
 
-            def ck_fn(it, booster, fit_base, final=False, _mgr=mgr,
-                      _done=done, _denom=params.rf_total or
-                      params.num_iterations):
-                _mgr.save(_done + it,
-                          {"booster": booster.save_model_string(),
+            def ck_fn(it, booster, fit_base, final=False, margin=None,
+                      rng_key=None, _mgr=mgr, _done=done,
+                      _denom=params.rf_total or params.num_iterations):
+                payload = {"booster": booster.save_model_string(),
                            "iteration": _done + it, "base": float(fit_base),
-                           "final": bool(final), "rf_denom": int(_denom)},
-                          prune_newer=final)
+                           "final": bool(final), "rf_denom": int(_denom)}
+                if margin is not None:
+                    payload["margin"] = np.asarray(margin, np.float32)
+                if rng_key is not None:
+                    payload["rng_key"] = np.asarray(rng_key)
+                if writer is None:
+                    _mgr.save(_done + it, payload, prune_newer=final)
+                elif final:
+                    writer.write_sync(_done + it, payload, prune_newer=True)
+                else:
+                    writer.submit(_done + it, payload)
             if remaining == 0:
                 return resume_booster, resume_base, []
         if self.parallelism and self._use_mesh():
@@ -283,11 +314,16 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                     valid=valid, init_booster=booster, callbacks=callbacks,
                     init_base=base)
             return booster, base, hist
-        return fit(x=x, y=y, params=params, weights=w, init_scores=init,
-                   group=group, valid=valid, callbacks=callbacks,
-                   init_booster=resume_booster, checkpoint_fn=ck_fn,
-                   checkpoint_interval=self.checkpoint_interval,
-                   init_base=resume_base)
+        try:
+            return fit(x=x, y=y, params=params, weights=w, init_scores=init,
+                       group=group, valid=valid, callbacks=callbacks,
+                       init_booster=resume_booster, checkpoint_fn=ck_fn,
+                       checkpoint_interval=self.checkpoint_interval,
+                       init_base=resume_base, init_margin=resume_margin,
+                       init_rng_key=resume_key, iter_offset=done)
+        finally:
+            if writer is not None:
+                writer.close()
 
     def _use_mesh(self) -> bool:
         import jax

@@ -1,5 +1,5 @@
 from . import cluster
-from .cluster import (ClusterInfo, barrier, broadcast_from_leader,
+from .cluster import (ClusterInfo, Heartbeat, barrier, broadcast_from_leader,
                       global_array, initialize_cluster,
                       padded_process_rows, process_row_range)
 from .mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
@@ -9,7 +9,7 @@ from .mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
 from .shard import shard_map
 
 __all__ = ["DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS",
-           "ClusterInfo", "barrier",
+           "ClusterInfo", "Heartbeat", "barrier",
            "broadcast_from_leader", "cluster", "data_mesh", "grid_mesh",
            "full_mesh", "global_array", "initialize_cluster",
            "pad_to_multiple", "padded_process_rows", "process_row_range",

@@ -21,11 +21,14 @@ Typical multi-host flow:
 """
 from __future__ import annotations
 
+import json
 import os
+import time
 from typing import NamedTuple, Optional
 
 import numpy as np
 
+from ..reliability.faults import FaultInjector
 from ..reliability.metrics import reliability_metrics
 from ..reliability.policy import RetryPolicy
 
@@ -167,6 +170,80 @@ def barrier(name: str = "barrier") -> None:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
+
+
+class Heartbeat:
+    """Lightweight per-process heartbeat/epoch file — how a restarted host
+    detects it is REJOINING a training job rather than starting one.
+
+    The reference has no equivalent (a lost Spark task simply fails the
+    job); with the TrainingSupervisor's checkpoint/resume this closes the
+    loop: each process writes `heartbeat_<pid>.json` (atomic tmp+replace)
+    with its last completed epoch, and a process that starts and finds its
+    own file knows it crashed or was preempted mid-job — the prior epoch
+    surfaces as the `cluster.resume_epoch` gauge (+`cluster.rejoins`
+    counter) and as `Heartbeat.resume_epoch`. `beat(epoch)` fires the
+    seeded `cluster.heartbeat` fault site so heartbeat loss is
+    chaos-testable; `clear()` removes the file on a CLEAN finish so the
+    next run starts fresh.
+    """
+
+    def __init__(self, directory: str, process_id: Optional[int] = None,
+                 faults: Optional[FaultInjector] = None, metrics=None):
+        os.makedirs(directory, exist_ok=True)
+        if process_id is None:
+            try:
+                import jax
+                process_id = jax.process_index()
+            except Exception:  # noqa: BLE001 - no backend: single process
+                process_id = 0
+        self.directory = directory
+        self.process_id = int(process_id)
+        self.path = os.path.join(directory,
+                                 f"heartbeat_{self.process_id}.json")
+        self._metrics = metrics if metrics is not None else reliability_metrics
+        self._faults = faults if faults is not None else FaultInjector.from_env()
+        prior = self.read()
+        self.resume_epoch: Optional[int] = (
+            None if prior is None else int(prior.get("epoch", 0)))
+        if prior is not None:
+            self._metrics.set_gauge("cluster.resume_epoch", self.resume_epoch)
+            self._metrics.inc("cluster.rejoins")
+
+    @property
+    def rejoining(self) -> bool:
+        """Did this process find its own prior heartbeat at startup?"""
+        return self.resume_epoch is not None
+
+    def beat(self, epoch: int) -> None:
+        """Atomically record the last completed epoch (tmp + os.replace —
+        a kill mid-beat leaves the previous beat, never a torn file)."""
+        if self._faults is not None:
+            self._faults.perturb("cluster.heartbeat")
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"process_id": self.process_id, "epoch": int(epoch),
+                       "time": time.time()}, f)
+        os.replace(tmp, self.path)
+
+    def read(self, process_id: Optional[int] = None) -> Optional[dict]:
+        """This (or another) process's last heartbeat; None when absent or
+        unreadable (a torn tmp never shadows the real file)."""
+        path = self.path if process_id is None else os.path.join(
+            self.directory, f"heartbeat_{int(process_id)}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def clear(self) -> None:
+        """Remove the heartbeat — call after a CLEAN finish so the next
+        start is a fresh job, not a rejoin."""
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
 
 
 def broadcast_from_leader(value: np.ndarray) -> np.ndarray:

@@ -7,9 +7,13 @@ from .faults import (FAULTS_ENV, Fault, FaultInjector, InjectedCrash,
 from .metrics import Counter, Histogram, MetricsRegistry, reliability_metrics
 from .policy import (Attempt, CircuitBreaker, CircuitOpenError, Deadline,
                      RetryBudget, RetryPolicy)
+from .supervisor import (AsyncCheckpointWriter, Preempted, StepTimeout,
+                         TrainingSupervisor)
 
 __all__ = ["RetryPolicy", "RetryBudget", "Attempt", "CircuitBreaker",
            "CircuitOpenError", "Deadline",
            "FaultInjector", "Fault", "InjectedFault", "InjectedCrash",
            "FAULTS_ENV",
-           "MetricsRegistry", "Counter", "Histogram", "reliability_metrics"]
+           "MetricsRegistry", "Counter", "Histogram", "reliability_metrics",
+           "TrainingSupervisor", "AsyncCheckpointWriter", "Preempted",
+           "StepTimeout"]

@@ -1,0 +1,512 @@
+"""Fault-tolerant training supervision: async verified checkpoints,
+preemption handling, deterministic crash-resume.
+
+The reference wraps every long-running LightGBM training phase in
+`FaultToleranceUtils.retryWithTimeout` and resumes multi-batch fits from
+serialized model strings (SURVEY §2.10, §5); our training loops previously
+died unrecoverably on a worker crash, a host preemption, or a torn
+checkpoint. `TrainingSupervisor` wraps ANY step-function training loop and
+provides the four guarantees the ISSUE demands:
+
+1. **Async checkpointing** — `snapshot_fn()` runs on the step thread (a
+   cheap host copy of params/opt-state), the npz/meta write happens on a
+   background `AsyncCheckpointWriter` thread behind a BOUNDED latest-wins
+   queue, so the hot loop never blocks on disk. Instrumented as
+   `checkpoint.write.{pending,coalesced,errors}` + the
+   `checkpoint.{submit,snapshot,write}` latency histograms.
+2. **Integrity** — writes go through `utils.checkpoint.CheckpointManager`,
+   which records per-file SHA-256 digests at save and verifies them on
+   restore, so resume skips silently-corrupted steps (not just truncated
+   ones) to the next-newest valid step.
+3. **Crash/preemption handling** — SIGTERM/SIGINT set a flag; the loop
+   finishes the in-flight step, writes a final SYNCHRONOUS checkpoint, and
+   raises `Preempted` (catch it and `sys.exit(0)` for the clean exit code a
+   preempting scheduler expects, or pass `exit_on_preempt=True`). A
+   `step_timeout` wall-clock budget per step raises `StepTimeout`; failed
+   steps (`restart_on`, by default injected faults + timeouts) restart from
+   the last in-memory snapshot under a `reliability.RetryPolicy`. Fault
+   sites `train.step<k>`, `train.ckpt.write`, and `train.ckpt.read` make
+   every failure mode seed-reproducible.
+4. **Deterministic resume** — the payload rides the data cursor (the step
+   index) and the per-step results history next to the model state, so a
+   killed-and-resumed run replays the remaining steps on bit-identical
+   state and produces bit-identical params/losses to an uninterrupted run
+   (pinned by tests/test_supervisor.py).
+
+Consumers: `ShardedLMTrainer.run_stream(checkpoint_dir=...)` and the GBDT
+estimators' `checkpoint_dir` path (which reuses `AsyncCheckpointWriter`
+directly — the boosting loop owns its own chunk cadence). See
+docs/reliability.md "Fault-tolerant training".
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import signal as _signal
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from ..utils.checkpoint import CheckpointManager
+from .faults import FaultInjector, InjectedFault
+from .metrics import reliability_metrics
+from .policy import RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+# Reserved payload keys the supervisor rides alongside the user's state.
+STEP_KEY = "sup_step"
+RESULTS_KEY = "sup_results"
+PREEMPTED_KEY = "sup_preempted"
+_RESERVED = (STEP_KEY, RESULTS_KEY, PREEMPTED_KEY)
+
+
+class StepTimeout(RuntimeError):
+    """A training step exceeded its wall-clock budget (`step_timeout`)."""
+
+
+class Preempted(RuntimeError):
+    """Raised by `TrainingSupervisor.run` after SIGTERM/SIGINT triggered the
+    final synchronous checkpoint. The run is resumable from that checkpoint;
+    catch this and `sys.exit(0)` so the scheduler sees a clean exit."""
+
+    def __init__(self, step: int, signum: int):
+        super().__init__(f"preempted by signal {signum} at step {step} "
+                         f"(final checkpoint written)")
+        self.step = step
+        self.signum = signum
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writer behind a bounded latest-wins queue.
+
+    `submit()` NEVER blocks the calling (step) thread: when the queue is
+    full the OLDEST pending snapshot is dropped (the newest state supersedes
+    it — counted under `checkpoint.write.coalesced`) and the new one is
+    enqueued. A failed async write is logged and counted
+    (`checkpoint.write.errors`) but does not kill training — a torn write
+    costs one checkpoint interval, exactly like a torn disk would.
+    `write_sync()` drains the queue then writes on the caller's thread (the
+    final/preemption checkpoint, which MUST be durable before exit).
+    """
+
+    def __init__(self, manager: CheckpointManager, depth: int = 2,
+                 metrics=None, faults: Optional[FaultInjector] = None):
+        self.manager = manager
+        self.depth = max(int(depth), 1)
+        self.metrics = metrics if metrics is not None else reliability_metrics
+        self.faults = faults if faults is not None else FaultInjector.from_env()
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._busy = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- producer side (step thread) -----------------------------------------
+    def submit(self, step: int, payload: dict,
+               prune_newer: bool = False) -> None:
+        t0 = time.perf_counter()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            while len(self._q) >= self.depth:
+                self._q.popleft()
+                self.metrics.inc("checkpoint.write.coalesced")
+            self._q.append((int(step), payload, bool(prune_newer)))
+            self.metrics.set_gauge("checkpoint.write.pending", len(self._q))
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True,
+                                                name="ckpt-writer")
+                self._thread.start()
+            self._cond.notify_all()
+        self.metrics.observe_ms("checkpoint.submit",
+                                (time.perf_counter() - t0) * 1000.0)
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._q) + (1 if self._busy else 0)
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Block until every submitted snapshot has been written."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._q or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"checkpoint writer did not drain within {timeout}s "
+                        f"({len(self._q)} pending)")
+                self._cond.wait(remaining)
+
+    def write_sync(self, step: int, payload: dict,
+                   prune_newer: bool = False,
+                   flush_timeout: float = 30.0) -> None:
+        """Drain pending async writes, then write THIS snapshot on the
+        caller's thread — the final checkpoint must be on disk when this
+        returns, so errors propagate instead of being absorbed."""
+        self.flush(timeout=flush_timeout)
+        self._write(int(step), payload, bool(prune_newer), absorb=False)
+
+    def close(self, flush: bool = True) -> None:
+        if flush:
+            try:
+                self.flush()
+            except TimeoutError:
+                logger.warning("checkpoint writer close(): flush timed out")
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- writer thread --------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait()
+                if not self._q and self._closed:
+                    return
+                step, payload, prune = self._q.popleft()
+                self._busy = True
+                self.metrics.set_gauge("checkpoint.write.pending",
+                                       len(self._q))
+            try:
+                self._write(step, payload, prune, absorb=True)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _write(self, step: int, payload: dict, prune_newer: bool,
+               absorb: bool) -> None:
+        t0 = time.perf_counter()
+        try:
+            if self.faults is not None:
+                self.faults.perturb("train.ckpt.write")
+            self.manager.save(step, payload, prune_newer=prune_newer)
+        except Exception as e:  # noqa: BLE001 - async writes must not kill training
+            self.metrics.inc("checkpoint.write.errors")
+            logger.warning("checkpoint write for step %d failed (%s: %s)",
+                           step, type(e).__name__, e)
+            if not absorb:
+                raise
+        finally:
+            self.metrics.observe_ms("checkpoint.write",
+                                    (time.perf_counter() - t0) * 1000.0)
+
+
+class TrainingSupervisor:
+    """Wrap a step-function training loop with checkpoint/resume, restart,
+    and preemption handling.
+
+        sup = TrainingSupervisor(ckpt_dir, snapshot_fn, restore_fn,
+                                 checkpoint_every=10)
+        losses = sup.run(step_fn, n_steps)   # resumes, restarts, finalizes
+
+    - `snapshot_fn() -> dict`: the training state as a CheckpointManager
+      payload (numpy arrays + JSON scalars). Called on the step thread —
+      keep it a cheap host copy; the disk write happens on the writer
+      thread. RNG state and any data-cursor state beyond the step index
+      must ride in this payload for resume to be deterministic.
+    - `restore_fn(payload) -> None`: apply a payload back onto live state.
+    - `step_fn(step) -> result`: one training step; results are collected
+      (and, when JSON-serializable, checkpointed so a resumed run returns
+      the full history).
+    - `seek(step)` (optional, per-`run`): position the data stream at
+      `step` — called once after resume and again after every crash rewind.
+
+    Restart policy: exceptions in `restart_on` (default: injected faults
+    and step timeouts) restore the last in-memory snapshot and replay from
+    its step; `retry_policy` bounds TOTAL restarts per run (jittered
+    backoff between them). Anything else propagates — the on-disk
+    checkpoints then make the NEXT process's `run()` resume.
+    """
+
+    def __init__(self, directory: str,
+                 snapshot_fn: Callable[[], dict],
+                 restore_fn: Callable[[dict], None], *,
+                 checkpoint_every: int = 1, max_to_keep: int = 3,
+                 queue_depth: int = 2,
+                 step_timeout: Optional[float] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 restart_on: Sequence[type] = (InjectedFault, StepTimeout),
+                 handle_signals: bool = True,
+                 heartbeat=None,
+                 manager: Optional[CheckpointManager] = None,
+                 metrics=None, faults: Optional[FaultInjector] = None):
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.checkpoint_every = max(int(checkpoint_every), 0)  # 0 = final only
+        self.step_timeout = step_timeout
+        self.restart_on = tuple(restart_on)
+        self.handle_signals = handle_signals
+        self.heartbeat = heartbeat
+        self.metrics = metrics if metrics is not None else reliability_metrics
+        self.faults = faults if faults is not None else FaultInjector.from_env()
+        self.manager = manager if manager is not None else CheckpointManager(
+            directory, max_to_keep=max_to_keep)
+        self.retry_policy = retry_policy if retry_policy is not None else \
+            RetryPolicy(max_attempts=3, backoff=0.05, max_backoff=1.0,
+                        metric_name="train.step_retries")
+        self.writer = AsyncCheckpointWriter(self.manager, depth=queue_depth,
+                                            metrics=self.metrics,
+                                            faults=self.faults)
+        self.resumed_step: Optional[int] = None
+        self._resumed_results: list = []
+        self._last: Optional[tuple] = None   # (step, payload, results) rewind
+        self._preempt: Optional[int] = None
+        self._att_gen = None
+        self._att = None
+        self._results_numeric = True    # losses ride the binary payload
+        self._results_jsonable = True   # flips once a non-JSON result shows
+        self._results_probed = 0        # results proven serializable so far
+
+    # -- resume ---------------------------------------------------------------
+    def resume(self) -> int:
+        """Restore the newest digest-valid checkpoint (if any) through
+        `restore_fn` and return the step to continue from (0 = fresh run).
+        Fires the `train.ckpt.read` fault site."""
+        if self.faults is not None:
+            self.faults.perturb("train.ckpt.read")
+        if self.manager.latest_step() is None:
+            return 0
+        payload, loaded = self.manager.restore(with_step=True)
+        # default to the step ACTUALLY loaded (a corrupt-newest fallback
+        # makes it differ from latest_step(); seeking the data cursor past
+        # state that never trained would silently skip batches)
+        step = int(payload.get(STEP_KEY, loaded))
+        hist = payload.get(RESULTS_KEY, ())
+        import numpy as np
+        if isinstance(hist, np.ndarray):   # numeric history rode the npz
+            hist = [float(v) for v in hist]
+        self._resumed_results = list(hist if hist is not None else ())
+        self.restore_fn({k: v for k, v in payload.items()
+                         if k not in _RESERVED})
+        self.resumed_step = step
+        self.metrics.inc("train.resumes")
+        self.metrics.set_gauge("train.resume_step", step)
+        logger.info("resumed training from checkpoint step %d", step)
+        return step
+
+    # -- the loop -------------------------------------------------------------
+    def run(self, step_fn: Callable[[int], object], n_steps: int, *,
+            seek: Optional[Callable[[int], None]] = None,
+            resume: bool = True, exit_on_preempt: bool = False) -> list:
+        start = self.resume() if resume else 0
+        results = list(self._resumed_results)
+        del results[start:]   # history beyond the restored step is stale
+        if start >= n_steps:
+            # the restored state is AT (or past) the requested horizon:
+            # nothing to run, and rewriting a final checkpoint at n_steps
+            # would understate the state the newer step dirs still hold
+            logger.warning(
+                "resumed checkpoint step %d >= n_steps %d; returning the "
+                "restored history without training", start, n_steps)
+            return results
+        step = start
+        self._mark(step, results, write=False)   # in-memory rewind baseline
+        if seek is not None:
+            seek(step)
+        old_handlers = self._install_signals()
+        try:
+            while step < n_steps:
+                if self._preempt is not None:
+                    self._finalize(step, results, preempted=True)
+                    if exit_on_preempt:
+                        raise SystemExit(0)
+                    raise Preempted(step, self._preempt)
+                try:
+                    if self.faults is not None:
+                        self.faults.perturb(f"train.step{step}")
+                    out = self._call_step(step_fn, step)
+                except self.restart_on as e:
+                    step, results = self._restart(e, seek)
+                    continue
+                results.append(out)
+                step += 1
+                if (self.checkpoint_every and step < n_steps
+                        and step % self.checkpoint_every == 0):
+                    self._mark(step, results, write=True)
+            if self._preempt is not None:
+                # the signal landed DURING the last step: it must not be
+                # silently swallowed by a clean finish — the scheduler
+                # expects the process to exit
+                self._finalize(step, results, preempted=True)
+                if exit_on_preempt:
+                    raise SystemExit(0)
+                raise Preempted(step, self._preempt)
+            self._finalize(n_steps, results, preempted=False)
+            return results
+        finally:
+            self._restore_signals(old_handlers)
+
+    def close(self) -> None:
+        self.writer.close(flush=True)
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempt is not None
+
+    # -- internals ------------------------------------------------------------
+    def _call_step(self, step_fn, step: int):
+        if self.step_timeout is None:
+            return step_fn(step)
+        box: dict = {}
+
+        def target():
+            try:
+                box["out"] = step_fn(step)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                box["err"] = e
+
+        t = threading.Thread(target=target, daemon=True,
+                             name=f"train-step-{step}")
+        t.start()
+        t.join(self.step_timeout)
+        if t.is_alive():
+            # The stuck step thread is ABANDONED (daemon) and the retried
+            # step runs fresh. Caveat: if the hung step later unblocks and
+            # mutates shared trainer state it races the replay — the
+            # timeout watchdog suits steps that hang in host I/O and die
+            # with the process (a truly wedged collective, a dead NFS
+            # mount), not steps that may eventually complete.
+            self.metrics.inc("train.step_timeouts")
+            raise StepTimeout(
+                f"step {step} exceeded its {self.step_timeout}s budget")
+        if "err" in box:
+            raise box["err"]
+        return box.get("out")
+
+    def _restart(self, err: BaseException, seek) -> tuple:
+        if self._att_gen is None:
+            self._att_gen = self.retry_policy.attempts()
+            self._att = next(self._att_gen)
+        if self._att.is_last:
+            raise err
+        self._att.retry()
+        self._att = next(self._att_gen, None)
+        if self._att is None:
+            raise err
+        assert self._last is not None
+        last_step, payload, results = self._last
+        self.metrics.inc("train.step_restarts")
+        logger.warning("training step failed (%s: %s); restarting from "
+                       "snapshot step %d", type(err).__name__, err, last_step)
+        self.restore_fn({k: v for k, v in payload.items()
+                         if k not in _RESERVED})
+        if seek is not None:
+            seek(last_step)
+        # rewind from the IN-MEMORY history, not the payload: non-JSON
+        # results never ride the payload, and an in-process restart must
+        # not discard them (only a cross-process resume legitimately does)
+        return last_step, list(results)
+
+    def _snapshot(self, step: int, results: list) -> dict:
+        import numpy as np
+        t0 = time.perf_counter()
+        payload = dict(self.snapshot_fn())
+        for k in _RESERVED:
+            payload.pop(k, None)
+        payload[STEP_KEY] = int(step)
+        if self._results_numeric and all(
+                isinstance(r, (int, float, np.floating, np.integer))
+                for r in results[self._results_probed:]):
+            # the common case (per-step losses): the history rides the
+            # BINARY payload — no O(history) json text per checkpoint
+            self._results_probed = len(results)
+            payload[RESULTS_KEY] = np.asarray(results, np.float64)
+        else:
+            self._results_numeric = False
+            if self._results_jsonable:
+                try:
+                    # probe only results not yet proven serializable — the
+                    # snapshot stays O(new results) per mark
+                    json.dumps(results[self._results_probed:])
+                    self._results_probed = len(results)
+                    payload[RESULTS_KEY] = list(results)
+                except (TypeError, ValueError):
+                    # non-JSON results: resumable, but history restarts
+                    self._results_jsonable = False
+        self.metrics.observe_ms("checkpoint.snapshot",
+                                (time.perf_counter() - t0) * 1000.0)
+        return payload
+
+    def _beat(self, step: Optional[int]) -> None:
+        """Heartbeat write (or clear, step=None) — an observability aid: a
+        lost beat (injected fault, NFS blip, disk full) is counted and
+        logged, never allowed to kill a healthy training loop."""
+        if self.heartbeat is None:
+            return
+        try:
+            if step is None:
+                self.heartbeat.clear()
+            else:
+                self.heartbeat.beat(step)
+        except Exception as e:  # noqa: BLE001 - observability must not kill
+            self.metrics.inc("cluster.heartbeat_errors")
+            logger.warning("heartbeat update failed (%s: %s)",
+                           type(e).__name__, e)
+
+    def _mark(self, step: int, results: list, write: bool) -> None:
+        payload = self._snapshot(step, results)
+        self._last = (step, payload, list(results))
+        if write:
+            self.writer.submit(step, payload)
+        self._beat(step)
+
+    def _finalize(self, step: int, results: list, preempted: bool) -> None:
+        payload = self._snapshot(step, results)
+        payload[PREEMPTED_KEY] = bool(preempted)
+        try:
+            self.writer.write_sync(step, payload)
+        except Exception as e:  # noqa: BLE001 - see preempt contract below
+            if not preempted:
+                raise   # a clean finish must not hide a lost final write
+            # preemption: the clean-exit contract (Preempted raised, the
+            # scheduler sees an orderly shutdown) outranks the final write
+            # — a wedged flush (slow NFS, stuck disk) must not turn a
+            # preemption into a crash. Best effort: try the direct write
+            # anyway (its step dir is distinct from the in-flight one);
+            # failing that, the periodic checkpoints still allow resume.
+            self.metrics.inc("checkpoint.finalize_errors")
+            logger.warning("final preemption checkpoint write failed "
+                           "(%s: %s); resuming will use the last periodic "
+                           "checkpoint", type(e).__name__, e)
+            try:
+                self.manager.save(step, payload)
+            except Exception:  # noqa: BLE001
+                pass
+        if preempted:
+            self.metrics.inc("train.preempted")
+            self._beat(step)
+        else:
+            self._beat(None)   # clean finish: next start is fresh
+
+    # -- signals --------------------------------------------------------------
+    def _install_signals(self):
+        if not self.handle_signals:
+            return None
+
+        def handler(signum, frame):
+            self._preempt = signum
+            self.metrics.inc("train.preempt_signals")
+
+        old = {}
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                old[sig] = _signal.signal(sig, handler)
+            except ValueError:   # not the main thread: poll-only preemption
+                break
+        return old
+
+    def _restore_signals(self, old) -> None:
+        if not old:
+            return
+        for sig, prev in old.items():
+            try:
+                _signal.signal(sig, prev)
+            except ValueError:
+                pass

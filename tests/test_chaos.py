@@ -6,6 +6,12 @@ identical fault schedule. Fixed seeds, no sleeps > 0.2s: this suite runs in
 tier-1 (`-m 'not slow'` collects it)."""
 import json
 import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import urllib.request
 
 import numpy as np
 import pytest
@@ -137,3 +143,66 @@ def test_chaos_breaker_trips_on_dead_dependency():
     clk[0] = 6.0
     breaker.call(lambda: "recovered")
     assert breaker.state == "closed"
+
+
+_SIGTERM_SERVER = """
+import json, signal, sys, time
+from mmlspark_tpu.io.serving import ServingServer, ServingQuery, drain_on_signal
+
+server = ServingServer(num_partitions=1, reply_timeout=10).start()
+
+def transform(bodies):
+    print("INFLIGHT", flush=True)      # parent SIGTERMs on seeing this
+    time.sleep(0.15)                   # the request spans the signal
+    return [{"ok": json.loads(b)["v"]} for b in bodies]
+
+q = ServingQuery(server, transform, poll_timeout=0.005).start()
+drain_on_signal(servers=[server], queries=[q], exit_code=0)
+print("ADDR", server.address, flush=True)
+while True:
+    time.sleep(0.5)
+"""
+
+
+def test_chaos_sigterm_drains_serving_before_exit(tmp_path):
+    """ISSUE 4 satellite: SIGTERM on a serving host routes through the
+    graceful stop() drain — the in-flight request is ANSWERED (200, right
+    payload) before the preempted process exits with a clean zero code."""
+    script = tmp_path / "serve.py"
+    script.write_text(textwrap.dedent(_SIGTERM_SERVER))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    child = subprocess.Popen([sys.executable, str(script)],
+                             stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        addr = None
+        for line in child.stdout:
+            if line.startswith("ADDR"):
+                addr = line.split()[1]
+                break
+        assert addr, "server never came up"
+
+        result = {}
+
+        def post():
+            req = urllib.request.Request(
+                addr, data=json.dumps({"v": 42}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                result["status"] = resp.status
+                result["body"] = json.loads(resp.read())
+
+        t = threading.Thread(target=post)
+        t.start()
+        for line in child.stdout:          # wait until the request is
+            if line.startswith("INFLIGHT"):   # actually being transformed
+                break
+        child.send_signal(signal.SIGTERM)
+        t.join(timeout=10)
+        assert child.wait(timeout=10) == 0     # clean preemption exit
+        assert result.get("status") == 200, result
+        assert result.get("body") == {"ok": 42}, result
+    finally:
+        if child.poll() is None:
+            child.kill()

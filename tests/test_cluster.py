@@ -54,3 +54,74 @@ def test_barrier_and_broadcast_single_process():
     barrier("test")  # must not hang
     out = broadcast_from_leader(np.array([1, 2, 3]))
     np.testing.assert_array_equal(out, [1, 2, 3])
+
+
+# -- heartbeat/rejoin (ISSUE 4: preempted hosts detect they are rejoining) ----
+
+def test_heartbeat_rejoin_detection(tmp_path):
+    import pytest
+    from mmlspark_tpu.parallel.cluster import Heartbeat
+    from mmlspark_tpu.reliability import (FaultInjector, InjectedFault,
+                                          reliability_metrics)
+    reliability_metrics.reset(prefix="cluster.")
+    hb = Heartbeat(str(tmp_path), process_id=0)
+    assert not hb.rejoining
+    hb.beat(3)
+    hb.beat(7)
+    # a restarted process finds its own file: it is REJOINING at epoch 7
+    hb2 = Heartbeat(str(tmp_path), process_id=0)
+    assert hb2.rejoining and hb2.resume_epoch == 7
+    assert reliability_metrics.gauge("cluster.resume_epoch") == 7
+    assert reliability_metrics.get("cluster.rejoins") == 1
+    # per-process files: another process id is independent
+    assert not Heartbeat(str(tmp_path), process_id=1).rejoining
+    # peers can read each other's epochs (laggard detection)
+    assert Heartbeat(str(tmp_path), process_id=1).read(0)["epoch"] == 7
+    # a clean finish clears the file -> next start is fresh, not a rejoin
+    hb2.clear()
+    assert not Heartbeat(str(tmp_path), process_id=0).rejoining
+    # the cluster.heartbeat fault site is seed-reproducible
+    inj = FaultInjector(seed=1, rules=[
+        {"site": "cluster.heartbeat", "kind": "error", "at": [0]}])
+    hb3 = Heartbeat(str(tmp_path), process_id=2, faults=inj)
+    with pytest.raises(InjectedFault):
+        hb3.beat(1)
+    assert ("cluster.heartbeat", 0, "error") in inj.schedule()
+
+
+def test_heartbeat_rides_supervisor_epochs(tmp_path):
+    """TrainingSupervisor(heartbeat=) beats at every checkpoint mark and
+    CLEARS on a clean finish; a preempted run leaves its last epoch for
+    the restarted process to detect."""
+    import os
+    import signal
+    import pytest
+    from mmlspark_tpu.parallel.cluster import Heartbeat
+    from mmlspark_tpu.reliability import Preempted, TrainingSupervisor
+    state = {"x": 0.0}
+    hb = Heartbeat(str(tmp_path / "hb"), process_id=0)
+
+    def mk(d, hb):
+        return TrainingSupervisor(
+            d, lambda: {"x": state["x"]},
+            lambda p: state.update(x=float(p["x"])),
+            checkpoint_every=2, heartbeat=hb)
+
+    sup = mk(str(tmp_path / "ck"), hb)
+
+    def step(k):
+        state["x"] += 1
+        if k == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return state["x"]
+
+    with pytest.raises(Preempted):
+        sup.run(step, 100)
+    sup.close()
+    hb2 = Heartbeat(str(tmp_path / "hb"), process_id=0)
+    assert hb2.rejoining and hb2.resume_epoch == 5  # preempted after step 4
+    sup2 = mk(str(tmp_path / "ck2"), hb2)
+    sup2.run(lambda k: k, 4)
+    sup2.close()
+    # clean finish: heartbeat cleared, next start is fresh
+    assert not Heartbeat(str(tmp_path / "hb"), process_id=0).rejoining

@@ -295,6 +295,79 @@ def test_seeded_crash_schedule_is_reproducible():
     assert histories[0] == histories[1] and histories[0]
 
 
+_KILL_MARKER = 1_234_567.0
+
+
+def _kill_on_marker(rows):
+    """Module-level (picklable) transform: SIGKILL the worker PROCESS when
+    it meets the marker row — a hard death mid-chunk, no Python cleanup."""
+    import os as _os
+    import signal as _signal
+    if float(rows[0, 0]) == _KILL_MARKER:
+        _os.kill(_os.getpid(), _signal.SIGKILL)
+    return rows * 2.0
+
+
+@pytest.mark.chaos
+def test_worker_killed_by_signal_reports_deterministic_chunk():
+    """ISSUE 4 satellite: a worker DEATH by signal (no traceback, no
+    marker) is detected by exitcode, and WorkerCrashError carries the
+    deterministic first-unreported chunk index — static strided assignment
+    makes chunk 2 always worker 0's second chunk."""
+    metrics = MetricsRegistry()
+    pool = WorkerPool(num_workers=2, mode="process", metrics=metrics)
+    x = np.arange(40 * 4, dtype=np.float32).reshape(40, 4)
+    x[20, 0] = _KILL_MARKER   # first row of chunk 2 (chunk_rows=10)
+    with pytest.raises(WorkerCrashError) as ei:
+        pool.map_rows(_kill_on_marker, x, out_width=4, chunk_rows=10)
+    assert ei.value.chunk_index == 2
+    assert "died" in str(ei.value) and "exitcode" in str(ei.value)
+    assert metrics.get("data.worker_failures") >= 1
+
+
+@pytest.mark.chaos
+def test_chunk_crash_supervisor_resume(tmp_path):
+    """Injected chunk crash + TrainingSupervisor: the ingest-backed step
+    raises WorkerCrashError with the deterministic chunk index, the
+    supervisor restarts it from the last snapshot, the retry succeeds
+    (per-site call counters advanced past the one-shot rule), and the run
+    ends bit-identical to a fault-free one."""
+    from mmlspark_tpu.reliability import TrainingSupervisor
+    from mmlspark_tpu.reliability.supervisor import StepTimeout
+    from mmlspark_tpu.reliability.faults import InjectedFault
+
+    x = np.arange(30 * 3, dtype=np.float32).reshape(30, 3)
+
+    def run(faults, directory):
+        pool = WorkerPool(num_workers=2, mode="thread", faults=faults,
+                          metrics=MetricsRegistry())
+        state = {"acc": np.zeros(3, np.float64)}
+        sup = TrainingSupervisor(
+            directory, lambda: {"acc": state["acc"].copy()},
+            lambda p: state.update(acc=np.asarray(p["acc"]).copy()),
+            checkpoint_every=1, faults=faults,
+            restart_on=(InjectedFault, StepTimeout, WorkerCrashError))
+
+        def step(k):
+            staged = pool.map_rows(lambda r: r * (k + 1), x, out_width=3,
+                                   chunk_rows=10)
+            state["acc"] = state["acc"] + staged.sum(axis=0)
+            return float(state["acc"][0])
+
+        try:
+            out = sup.run(step, 3)
+        finally:
+            sup.close()
+        return out, state["acc"]
+
+    ref, acc_ref = run(None, str(tmp_path / "ref"))
+    inj = FaultInjector(seed=11, rules=[
+        {"site": "data.worker.chunk1", "kind": "crash", "at": [0]}])
+    out, acc = run(inj, str(tmp_path / "faulted"))
+    assert out == ref and np.array_equal(acc, acc_ref)
+    assert ("data.worker.chunk1", 0, "crash") in inj.schedule()
+
+
 # -- overlap -----------------------------------------------------------------
 
 def test_prefetch_keeps_consumer_unstarved():
