@@ -450,6 +450,89 @@ def _bench_serving():
         **out}))
 
 
+def _bench_telemetry():
+    """Telemetry overhead A/B (ISSUE 5 satellite): the SAME closed-loop
+    serving harness as BENCH_MODE=serving (real fitted GBDT booster,
+    compiled fast path, coalesced microbatch) runs three times —
+
+    - off:     span sampling 0% (the production default; one float compare
+               per request is the whole cost),
+    - sampled: 1% deterministic head sampling (the recommended always-on
+               production setting),
+    - full:    100% (every request minted a root span + transform child),
+
+    — and reports req/s + p50 for each. BUDGET (asserted HERE, never in
+    tier-1 tests — wall clock on a contended host is bench territory):
+    sampled-mode throughput must stay within 20% of off (the stated
+    overhead budget; quiet-host runs measure low single digits). The full
+    run also scrapes GET /metrics once and sanity-checks the Prometheus
+    exposition + span-ring stats so the artifact proves the exposition
+    path live under load."""
+    import urllib.request
+    from mmlspark_tpu import telemetry
+    from mmlspark_tpu.core import Table
+    from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.reliability.metrics import reliability_metrics
+
+    rng = np.random.default_rng(0)
+    n, f = 20_000, 16
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    model = GBDTClassifier(num_iterations=20, max_depth=5).fit(
+        Table({"features": x, "label": y}))
+    body = json.dumps({"features": [0.1] * f})
+
+    out = {}
+    expo_text = ""
+    for tag, rate in (("off", 0.0), ("sampled", 0.01), ("full", 1.0)):
+        telemetry.configure(sample=rate)
+        telemetry.get_tracer().clear()
+        reliability_metrics.reset("serving.")
+        server, q = serve_pipeline(model, input_cols=["features"],
+                                   mode="microbatch", max_batch=256,
+                                   fast_path=True)
+        host, port = server._httpd.server_address[:2]
+        try:
+            res = run_load(host, port, body, n_clients=16, per_client=125)
+            assert not res.errors, res.errors[:3]
+            if rate == 1.0:
+                expo_text = urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=10
+                ).read().decode()
+        finally:
+            q.stop()
+            server.stop()
+        stats = telemetry.get_tracer().stats()
+        out[f"{tag}_req_per_sec"] = round(res.req_per_sec, 1)
+        out[f"{tag}_p50_ms"] = round(res.p50_ms, 2)
+        out[f"{tag}_p99_ms"] = round(res.p99_ms, 2)
+        out[f"{tag}_spans"] = stats["spans"] + stats["dropped"]
+    telemetry.configure(sample=0.0)
+
+    assert "serving_request_e2e_seconds_bucket" in expo_text, \
+        "GET /metrics under load lost the e2e histogram"
+    assert out["off_spans"] == 0 and out["full_spans"] > 0
+    out["sampled_overhead_pct"] = round(
+        (1.0 - out["sampled_req_per_sec"]
+         / max(out["off_req_per_sec"], 1e-9)) * 100.0, 1)
+    out["full_overhead_pct"] = round(
+        (1.0 - out["full_req_per_sec"]
+         / max(out["off_req_per_sec"], 1e-9)) * 100.0, 1)
+    out["sampled_overhead_budget_pct"] = 20.0
+    assert out["sampled_overhead_pct"] <= out["sampled_overhead_budget_pct"], \
+        (f"1% sampling cost {out['sampled_overhead_pct']}% throughput — "
+         f"over the {out['sampled_overhead_budget_pct']}% budget")
+    print(json.dumps({
+        "metric": "serving_telemetry_sampled_req_per_sec",
+        "value": out["sampled_req_per_sec"], "unit": "req/s",
+        # >= ~1.0 means 1% sampling is throughput-free within noise
+        "vs_baseline": round(out["sampled_req_per_sec"]
+                             / max(out["off_req_per_sec"], 1e-9), 3),
+        "exposition_bytes": len(expo_text), **out}))
+
+
 def _bench_ckpt():
     """Checkpoint stall per training step, sync vs async (ISSUE 4
     tooling satellite): the SAME LM stream-training loop runs (a) with no
@@ -840,6 +923,8 @@ def main():
         return _bench_serving()
     if mode == "ckpt":
         return _bench_ckpt()
+    if mode == "telemetry":
+        return _bench_telemetry()
     # predict/shap modes never print the bandwidth fields — don't spend the
     # ~40 timed 1 GiB copy passes measuring one
     copy_gbps = (0.0 if mode in ("predict", "shap")

@@ -29,6 +29,7 @@ import threading
 from typing import Callable, Iterable, Iterator, Optional
 
 from ..reliability.metrics import reliability_metrics
+from ..telemetry.spans import get_tracer
 from ..utils import tracing
 
 _DONE = object()
@@ -55,6 +56,8 @@ class DevicePrefetcher:
                                         name="ingest-prefetch")
         self._started = False
         self._consumed = 0
+        self._stalls = 0
+        self._span = None   # lifecycle span: started with the feeder
 
     # -- feeder --------------------------------------------------------------
     def _feed(self) -> None:
@@ -88,6 +91,11 @@ class DevicePrefetcher:
     def __iter__(self) -> Iterator:
         if not self._started:
             self._started = True
+            # one span per prefetch lifetime (not per item): finished with
+            # the items/stalls totals, so a trace shows whether the overlap
+            # actually hid the producer
+            self._span = get_tracer().start_span(
+                "data.prefetch", attrs={"depth": self._q.maxsize})
             self._thread.start()
         return self
 
@@ -102,14 +110,23 @@ class DevicePrefetcher:
         item = self._q.get()
         if item is _DONE:
             self._thread.join(timeout=5)
+            self._finish_span()
             raise StopIteration
         if isinstance(item, Exception):
             self._stop.set()
+            self._finish_span(error=type(item).__name__)
             raise item
         if was_empty:
+            self._stalls += 1
             self._metrics.inc("data.prefetch.stalls")
         self._consumed += 1
         return item
+
+    def _finish_span(self, **attrs) -> None:
+        if self._span is not None:
+            self._span.finish(items=self._consumed, stalls=self._stalls,
+                              **attrs)
+            self._span = None
 
     def queue_depth(self) -> int:
         """Current ready-batch count (approximate; for monitoring/tests)."""
@@ -125,6 +142,7 @@ class DevicePrefetcher:
             pass
         if self._started:
             self._thread.join(timeout=5)
+        self._finish_span(closed=True)
 
     def __enter__(self) -> "DevicePrefetcher":
         return self

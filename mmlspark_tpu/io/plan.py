@@ -45,6 +45,7 @@ from ..core import PipelineModel, Table
 from ..core.params import Params
 from ..reliability.metrics import reliability_metrics
 from ..stages.batching import pad_rows_to_bucket, shape_bucket
+from ..telemetry.spans import get_tracer
 from .serving import Reply, _jsonable
 
 
@@ -247,8 +248,12 @@ class ServingTransform:
                 data = assemble([row for _, row in survivors])
                 del batch_err
             # model execution: exceptions here are SERVER faults and
-            # propagate to the worker's replay/502 machinery untouched
-            vals = np.asarray(run(data))
+            # propagate to the worker's replay/502 machinery untouched.
+            # The span joins the ambient request trace the serving worker
+            # activated (no-op when the batch is unsampled).
+            with get_tracer().span("serving.plan.run",
+                                   rows=len(good_idx)):
+                vals = np.asarray(run(data))
             prefix, suffix = self._prefix, self._suffix
             if vals.ndim == 1 and vals.dtype.kind == "f":
                 # scalar-float fast path: Python float repr IS shortest

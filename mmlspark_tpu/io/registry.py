@@ -26,6 +26,7 @@ from http.server import BaseHTTPRequestHandler
 from typing import NamedTuple, Optional
 
 from ..reliability.policy import RetryPolicy
+from ..telemetry.spans import get_tracer
 from .serving import _ThreadingServer
 
 
@@ -60,6 +61,13 @@ class _RegistryHandler(BaseHTTPRequestHandler):
         except ValueError:
             return self._json(400, {"error": "bad json"})
         reg: "ServiceRegistry" = self.server.registry  # type: ignore
+        # trace propagation terminus: a RegistryClient/worker post carrying
+        # X-Trace-Id lands its registry hop in the same trace
+        tracer = get_tracer()
+        ctx = tracer.extract(dict(self.headers))
+        if ctx is not None and ctx.sampled:
+            tracer.record("registry" + self.path.replace("/", "."),
+                          parent=ctx, kind="event")
         if self.path == "/register":
             try:
                 info = ServiceInfo(**body)
@@ -77,6 +85,16 @@ class _RegistryHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802
         reg: "ServiceRegistry" = self.server.registry  # type: ignore
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/metrics.json"):
+            from ..telemetry.exposition import metrics_http_response
+            status, payload, ctype = metrics_http_response(path)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
         if self.path.startswith("/services/"):
             name = self.path[len("/services/"):]
             return self._json(200, [i._asdict() for i in reg.services(name)])
@@ -152,10 +170,11 @@ def report_server_to_registry(registry_address: str, name: str, host: str,
                        process_id=process_id, num_partitions=num_partitions)
     data = json.dumps(info._asdict()).encode()
     last_err: Optional[Exception] = None
+    headers = get_tracer().inject({"Content-Type": "application/json"})
     for att in policy.attempts():
         req = urllib.request.Request(
             registry_address + "/register", data=data,
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=headers, method="POST")
         try:
             with urllib.request.urlopen(
                     req, timeout=att.timeout(5.0) or 5.0) as resp:
@@ -247,12 +266,15 @@ class RegistryClient:
         socket (stale keep-alive: the server closed it between posts)
         retries once on a fresh connection to the same server; a fresh
         connection's failure propagates to the failover loop."""
+        # active sampled trace context propagates (X-Trace-Id) so the
+        # receiving server's ingress span joins THIS trace; inject() is a
+        # contextvar read when no trace is active
+        headers = get_tracer().inject({"Content-Type": content_type})
         for _ in range(2):
             conn = self._conn_for(t)
             reused = conn.sock is not None
             try:
-                conn.request("POST", path, body=body,
-                             headers={"Content-Type": content_type})
+                conn.request("POST", path, body=body, headers=headers)
                 resp = conn.getresponse()
                 return resp.status, resp.read()
             except (http.client.HTTPException, ConnectionError, OSError):

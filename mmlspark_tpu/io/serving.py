@@ -44,6 +44,7 @@ import numpy as np
 from ..core import Table
 from ..reliability.faults import FaultInjector, InjectedCrash
 from ..reliability.metrics import reliability_metrics
+from ..telemetry.spans import TRACE_HEADER, get_tracer
 
 
 class Reply(NamedTuple):
@@ -68,7 +69,7 @@ class CachedRequest:
     """One held HTTP exchange (reference: CachedRequest, HTTPSourceV2.scala:519)."""
 
     __slots__ = ("id", "body", "headers", "path", "_event", "_response",
-                 "_on_respond", "t_enqueue")
+                 "_on_respond", "t_enqueue", "span")
 
     def __init__(self, body: bytes, headers: dict, path: str,
                  on_respond=None):
@@ -80,10 +81,16 @@ class CachedRequest:
         self._response: Optional[tuple] = None
         self._on_respond = on_respond   # selector transport wakeup
         self.t_enqueue = 0.0            # stamped by ServingServer._enqueue
+        self.span = None                # ingress root span (telemetry)
 
     def respond(self, status: int, body: bytes,
                 content_type: str = "application/json"):
         self._response = (status, body, content_type)
+        if self.span is not None:
+            # root span ends when the response is ROUTED (what the held
+            # client experiences); finish is idempotent — the expiry/reply
+            # race may touch it twice
+            self.span.finish(status=status)
         self._event.set()
         if self._on_respond is not None:
             self._on_respond()
@@ -114,11 +121,37 @@ class _Handler(BaseHTTPRequestHandler):
         serving._enqueue(cached)
         resp = cached.wait(serving.reply_timeout)
         if resp is None:
+            # the CLIENT sees 504: stamp the span to agree. Best-effort —
+            # finish is first-wins, so a worker reply landing in the
+            # microseconds between wait() expiring and this line can still
+            # record its 200; without this stamp EVERY timed-out request
+            # recorded the worker's status instead of the client's
+            if cached.span is not None:
+                cached.span.finish(status=504, timeout=True)
             self.send_response(504)
+            # the correlation id must ride EVERY response — the slow
+            # request that timed out is exactly the one worth tracing
+            self.send_header("X-Request-Id", cached.id)
             self.end_headers()
             self.wfile.write(b'{"error": "serving timeout"}')
             return
         status, payload, ctype = resp
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        # client-visible correlation id == server-side root span id
+        self.send_header("X-Request-Id", cached.id)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802
+        serving: "ServingServer" = self.server.serving  # type: ignore
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/metrics.json"):
+            status, payload, ctype = serving._metrics_response(path)
+        else:
+            status, ctype = 404, "application/json"
+            payload = b'{"error": "not found"}'
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
@@ -386,6 +419,20 @@ class _SelectorServer:
                 return
             body = conn.rbuf[head_end + 4:total]
             conn.rbuf = conn.rbuf[total:]
+            bare_path = path.split("?", 1)[0]
+            if bare_path in ("/metrics", "/metrics.json"):
+                # exposition endpoint: answered on the loop thread, never
+                # enqueued to partition workers (and exempt from ingress
+                # fault injection / drain shedding — the scrape is how you
+                # WATCH a draining server). Rides the normal in-order
+                # response machinery so pipelined predecessors stay intact.
+                req = CachedRequest(body, headers, path)
+                conn.inflight.append(req)
+                status, payload, ctype = \
+                    self.serving._metrics_response(bare_path)
+                req.respond(status, payload, ctype)
+                self._flush(conn)
+                continue
             inj = self.serving._faults
             if inj is not None:
                 fault = inj.fire("serving.ingress")
@@ -412,7 +459,10 @@ class _SelectorServer:
             self._deadlines.pop(req.id, None)
             status, payload, ctype = req._response
             out.append(_response_head(status, ctype))
-            out.append(b"%d\r\n\r\n" % len(payload))
+            # X-Request-Id echoes the server-side correlation id (== the
+            # root span id) so the client can quote it against traces
+            out.append(b"%d\r\nX-Request-Id: %b\r\n\r\n"
+                       % (len(payload), req.id.encode("latin-1")))
             out.append(payload)
         if out:
             conn.wbuf += b"".join(out)
@@ -621,8 +671,41 @@ class ServingServer:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
 
+    def _metrics_response(self, path: str) -> tuple:
+        """(status, payload, content_type) for GET /metrics[.json] — the
+        Prometheus/JSON exposition of the process-wide MetricsRegistry
+        (telemetry.exposition; mounted on both transports)."""
+        from ..telemetry.exposition import metrics_http_response
+        return metrics_http_response(path)
+
+    def _start_request_span(self, req: CachedRequest):
+        """Ingress root span. A fresh trace uses the REQUEST ID as the
+        trace id — the id the client reads back in `X-Request-Id` is then
+        the trace id AND the root span id, one id everywhere. An incoming
+        `X-Trace-Id` header joins its trace instead (the request id still
+        names the root span within it)."""
+        tracer = get_tracer()
+        headers = req.headers
+        if (tracer.sample_rate <= 0.0
+                and TRACE_HEADER not in headers
+                and "x-trace-id" not in headers
+                and "X-trace-id" not in headers):
+            # disabled fast path: three dict membership tests covering the
+            # spellings real clients send (exact, selector-lowercased,
+            # urllib-capitalized) — extract()'s per-key scan was measurable
+            # at ingress rates. Exotic casings only join when sampling is on.
+            return None
+        ctx = tracer.extract(headers)
+        if ctx is None and tracer.sample_rate <= 0.0:
+            return None
+        return tracer.start_span(
+            "serving.request", parent=ctx,
+            trace_id=None if ctx is not None else req.id,
+            span_id=req.id, attrs={"path": req.path})
+
     # -- ingress ------------------------------------------------------------
     def _enqueue(self, req: CachedRequest):
+        req.span = self._start_request_span(req)
         if self._draining:
             # drain: in-flight work finishes, NEW work is refused
             reliability_metrics.inc("serving.shed_requests")
@@ -861,9 +944,30 @@ class ServingQuery:
         reliability_metrics.set_gauge("serving.batch.occupancy",
                                       len(live) / max(self.max_batch, 1))
         bodies = [r.body for r in live]
+        # trace context rides into the transform: nested spans (the
+        # compiled-plan run in io/plan.py, downstream RegistryClient posts)
+        # attach under the batch's FIRST sampled request — a coalesced
+        # batch shares one execution, so it shares one ambient parent
+        tracer = get_tracer()
+        parent = next((r.span for r in live if r.span is not None), None)
         t0 = time.perf_counter()
-        replies = self.transform_fn(bodies)
+        if parent is not None:
+            with tracer.use(parent):
+                replies = self.transform_fn(bodies)
+        else:
+            replies = self.transform_fn(bodies)
         t1 = time.perf_counter()
+        if parent is not None:
+            # one transform span PER SAMPLED REQUEST (each parented to its
+            # own ingress span, so every trace shows its worker hop), all
+            # stamped with the shared batch duration
+            dur_ms = (t1 - t0) * 1000.0
+            for r in live:
+                if r.span is not None:
+                    tracer.record("serving.partition.transform",
+                                  parent=r.span, duration_ms=dur_ms,
+                                  attrs={"partition": pid, "epoch": epoch,
+                                         "batch": len(live)})
         for r, reply in zip(live, replies):
             self._reply_one(r, reply)
         t2 = time.perf_counter()

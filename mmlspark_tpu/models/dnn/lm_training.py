@@ -246,13 +246,16 @@ class ShardedLMTrainer:
         (step_timeout, retry_policy, heartbeat, faults, ...) pass through
         to TrainingSupervisor."""
         import operator
+        import time as _time
 
         import jax.numpy as jnp
         from ...data import DevicePrefetcher
+        from ...telemetry.spans import get_tracer
         steps_per_batch = operator.index(steps_per_batch)
         if steps_per_batch < 1:
             raise ValueError(
                 f"steps_per_batch must be >= 1, got {steps_per_batch}")
+        _run_t0 = _time.perf_counter()
 
         def one_batch(tok_dev):
             if steps_per_batch == 1:
@@ -278,6 +281,10 @@ class ShardedLMTrainer:
                                   put=self._to_device) as pf:
                 for tok_dev in pf:
                     losses.append(one_batch(tok_dev))
+            get_tracer().record(
+                "lm.run_stream",
+                duration_ms=(_time.perf_counter() - _run_t0) * 1000.0,
+                attrs={"steps": len(losses), "supervised": False})
             return losses
 
         from ...reliability.supervisor import TrainingSupervisor
@@ -315,7 +322,13 @@ class ShardedLMTrainer:
                                  checkpoint_every=checkpoint_every,
                                  **supervisor_kw)
         try:
-            return sup.run(step_fn, len(batches), seek=seek, resume=resume)
+            out = sup.run(step_fn, len(batches), seek=seek, resume=resume)
+            get_tracer().record(
+                "lm.run_stream",
+                duration_ms=(_time.perf_counter() - _run_t0) * 1000.0,
+                attrs={"steps": len(out), "supervised": True,
+                       "resumed_step": sup.resumed_step or 0})
+            return out
         finally:
             if stream["pf"] is not None:
                 stream["pf"].close()

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Callable, Optional
 
 import jax
@@ -23,6 +24,7 @@ import numpy as np
 
 from ...ops import binning
 from ...reliability.metrics import reliability_metrics
+from ...telemetry.spans import get_tracer
 from ...utils import tracing
 from . import objectives as obj_mod
 from . import trainer
@@ -370,22 +372,48 @@ def _build_booster(sf, sb, lv, tree_classes, mapper, p: BoostParams,
     return booster
 
 
-def fit_booster(x: np.ndarray, y: np.ndarray,
-                params: BoostParams,
-                weights: Optional[np.ndarray] = None,
-                init_scores: Optional[np.ndarray] = None,
-                group: Optional[np.ndarray] = None,
-                valid: Optional[tuple] = None,
-                init_booster: Optional[Booster] = None,
-                callbacks: Optional[Callbacks] = None,
-                tree_fn=None, put_fn=None, chunk_fn=None,
-                prebinned: Optional[tuple] = None,
-                presence: Optional[np.ndarray] = None,
-                checkpoint_fn=None, checkpoint_interval: int = 25,
-                init_base: float = 0.0, ingest=None,
-                init_margin: Optional[np.ndarray] = None,
-                init_rng_key: Optional[np.ndarray] = None,
-                iter_offset: int = 0):
+def fit_booster(x: np.ndarray, y: np.ndarray, params: BoostParams,
+                *args, **kwargs):
+    """Train a Booster on host arrays (see `_fit_booster_impl` for the full
+    parameter list — this wrapper owns only the telemetry lifecycle).
+
+    The `gbdt.fit` span wraps the WHOLE fit so a fit that dies (injected
+    fault, bad params, device OOM) still lands in the span log with its
+    error — per-iteration/per-chunk children attach through the activated
+    context inside."""
+    _tel = get_tracer()
+    span = _tel.start_span("gbdt.fit", attrs={
+        "rows": int(x.shape[0]), "features": int(x.shape[1]),
+        "iterations": int(params.num_iterations),
+        "objective": params.objective, "boosting": params.boosting})
+    if span is None:
+        return _fit_booster_impl(x, y, params, *args, **kwargs)
+    try:
+        with _tel.use(span):
+            out = _fit_booster_impl(x, y, params, *args, **kwargs)
+    except BaseException as e:
+        span.finish(error=type(e).__name__)
+        raise
+    span.finish(trees=int(out[0].n_trees))
+    return out
+
+
+def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
+                      params: BoostParams,
+                      weights: Optional[np.ndarray] = None,
+                      init_scores: Optional[np.ndarray] = None,
+                      group: Optional[np.ndarray] = None,
+                      valid: Optional[tuple] = None,
+                      init_booster: Optional[Booster] = None,
+                      callbacks: Optional[Callbacks] = None,
+                      tree_fn=None, put_fn=None, chunk_fn=None,
+                      prebinned: Optional[tuple] = None,
+                      presence: Optional[np.ndarray] = None,
+                      checkpoint_fn=None, checkpoint_interval: int = 25,
+                      init_base: float = 0.0, ingest=None,
+                      init_margin: Optional[np.ndarray] = None,
+                      init_rng_key: Optional[np.ndarray] = None,
+                      iter_offset: int = 0):
     """Train a Booster on host arrays. Single-device by default; the
     distributed path (distributed.py) passes a shard_map-wrapped `tree_fn`
     and a sharding `put_fn`, and this same loop runs over the mesh.
@@ -415,6 +443,16 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
     p = params
     cb = callbacks or Callbacks()
     n, n_features = x.shape
+    # telemetry: the `gbdt.fit` wrapper span is the ambient context here;
+    # per-iteration (host loop) / per-chunk (fused scan) children attach to
+    # it. No ambient context (unsampled fit) -> every mark is one compare.
+    _tel = get_tracer()
+
+    def _iter_mark(it_idx, t0):
+        if _tel.current() is not None:
+            _tel.record("gbdt.iteration",
+                        duration_ms=(time.perf_counter() - t0) * 1000.0,
+                        attrs={"iteration": int(it_idx) + iter_offset})
     multiclass = p.objective == "multiclass"
     k_out = p.num_class if multiclass else 1
     put = put_fn or jnp.asarray
@@ -627,6 +665,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
         margin_init = (margin_no_continuation if rf and init_booster is not None
                        else margin)
         while it < p.num_iterations:
+            _chunk_t0 = time.perf_counter()
             clen = min(chunk, p.num_iterations - it)
             key, kc = jax.random.split(key)
             (margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c,
@@ -663,6 +702,14 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                         if patience > 0 and rounds_since >= patience:
                             stop_at = it + i + 1
                             break
+            if _tel.current() is not None:
+                # the fused scan has no host-visible per-iteration boundary;
+                # the chunk IS the granularity device work surfaces at
+                _tel.record("gbdt.chunk",
+                            duration_ms=(time.perf_counter() - _chunk_t0)
+                            * 1000.0,
+                            attrs={"first_iteration": it + iter_offset,
+                                   "iterations": int(clen)})
             it += clen
             if stop_at is not None:
                 break
@@ -704,6 +751,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
 
     n_grown = 0
     for it in range(p.num_iterations):
+        _it_t0 = time.perf_counter()
         if cb.before_iteration:
             cb.before_iteration(it)
         lr = cb.get_learning_rate(it) if cb.get_learning_rate else p.learning_rate
@@ -835,6 +883,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
             if p.early_stopping_round > 0 and rounds_since >= p.early_stopping_round:
                 if cb.after_iteration:
                     cb.after_iteration(it, metric_val)
+                _iter_mark(it, _it_t0)
                 break
         if cb.after_iteration:
             cb.after_iteration(it, metric_val if metric_val is not None else float("nan"))
@@ -855,6 +904,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray,
                 k_out, n_features, -1, init_booster, base, gain=_gn,
                 cover=_cv, is_cat=_ic, cat_words=_cw), base, final=False,
                 margin=margin, rng_key=key)
+        _iter_mark(it, _it_t0)
 
     max_nodes = 2 ** (p.max_depth + 1) - 1
     T = len(trees)

@@ -100,10 +100,25 @@ class FaultInjector:
             self._rngs[site] = rng
         return rng
 
+    # -- telemetry -------------------------------------------------------------
+    def _emit(self, fault: Fault) -> None:
+        """Structured telemetry event per fired fault: a chaos run's event
+        log then interleaves injections with the recovery they provoked
+        (supervisor restarts, replayed epochs) in causal (seq) order.
+        Lazy import + exception guard: observability must never change a
+        fault schedule's behavior."""
+        try:
+            from ..telemetry.spans import get_tracer
+            get_tracer().event("fault.injected", site=fault.site,
+                               index=fault.index, kind=fault.kind)
+        except Exception:  # noqa: BLE001
+            pass
+
     # -- core ------------------------------------------------------------------
     def fire(self, site: str) -> Optional[Fault]:
         """Advance the site's call counter and return the fault scheduled
         for this call, if any. First matching rule wins."""
+        fault = None
         with self._lock:
             index = self._counts.get(site, 0)
             self._counts[site] = index + 1
@@ -118,8 +133,10 @@ class FaultInjector:
                     continue
                 fault = Fault(site, index, rule["kind"], rule.get("param"))
                 self.history.append(fault)
-                return fault
-        return None
+                break
+        if fault is not None:
+            self._emit(fault)
+        return fault
 
     def perturb(self, site: str) -> Optional[Fault]:
         """fire() plus the generic kinds applied in place: "delay" sleeps
@@ -157,20 +174,25 @@ class FaultInjector:
             self._counts[site] = index + 1
             rng = self._site_rng(site)
             mode = rng.choice(self.CORRUPT_MODES)
-            self.history.append(Fault(site, index, f"corrupt:{mode}"))
+            fault = Fault(site, index, f"corrupt:{mode}")
+            self.history.append(fault)
             if not data:
-                return data
-            if mode == "truncate":
-                return data[: rng.randrange(len(data))]
-            if mode == "flip":
+                out_bytes = data
+            elif mode == "truncate":
+                out_bytes = data[: rng.randrange(len(data))]
+            elif mode == "flip":
                 out = bytearray(data)
                 for _ in range(max(1, len(out) // 16)):
                     pos = rng.randrange(len(out))
                     out[pos] ^= 1 + rng.randrange(255)
-                return bytes(out)
-            junk = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 9)))
-            pos = rng.randrange(len(data) + 1)
-            return data[:pos] + junk + data[pos:]
+                out_bytes = bytes(out)
+            else:
+                junk = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 9)))
+                pos = rng.randrange(len(data) + 1)
+                out_bytes = data[:pos] + junk + data[pos:]
+        self._emit(fault)
+        return out_bytes
 
     def corrupt_file(self, path: str, site: str = "checkpoint") -> None:
         """Truncate a file to a seeded fraction of its size — the
@@ -181,8 +203,9 @@ class FaultInjector:
             index = self._counts.get(site, 0)
             self._counts[site] = index + 1
             keep = self._site_rng(site).randrange(max(size, 1))
-            self.history.append(Fault(site, index, "corrupt:truncate-file",
-                                      float(keep)))
+            fault = Fault(site, index, "corrupt:truncate-file", float(keep))
+            self.history.append(fault)
+        self._emit(fault)
         with open(path, "rb+") as f:
             f.truncate(keep)
 

@@ -62,6 +62,13 @@ _HIST_BOUNDS = tuple(_HIST_LO_MS * _HIST_RATIO ** i
                      for i in range(_HIST_BUCKETS - 1))
 
 
+def histogram_bounds_ms() -> tuple:
+    """The shared geometric bucket upper bounds (ms) every Histogram uses —
+    public so telemetry exposition can render cumulative Prometheus buckets
+    and merge cross-process states without poking privates."""
+    return _HIST_BOUNDS
+
+
 class Histogram:
     """Bounded-bucket latency histogram (HDR-style geometric buckets).
 
@@ -124,11 +131,44 @@ class Histogram:
     def snapshot(self) -> dict:
         with self._lock:
             count, total = self._count, self._sum_ms
+        mean = total / count if count else 0.0
+        # `sum`/`mean` (ms) let exposition compute rates without re-walking
+        # buckets; existing keys stay stable (mean_ms == mean, kept for
+        # older readers)
         return {"count": count,
-                "mean_ms": total / count if count else 0.0,
+                "mean_ms": mean,
+                "sum": total,
+                "mean": mean,
                 "p50": self.percentile(50.0),
                 "p95": self.percentile(95.0),
                 "p99": self.percentile(99.0)}
+
+    # -- raw state (exposition / cross-process merge) -------------------------
+    def state(self) -> dict:
+        """Raw bucket counts + aggregates — the mergeable form. Every
+        Histogram shares the module-level bounds, so merging two states is
+        an elementwise count sum."""
+        with self._lock:
+            return {"counts": list(self._counts), "count": self._count,
+                    "sum_ms": self._sum_ms,
+                    "min_ms": self._min_ms if self._count else None,
+                    "max_ms": self._max_ms}
+
+    @classmethod
+    def from_state(cls, name: str, state: dict) -> "Histogram":
+        counts = list(state["counts"])
+        if len(counts) != _HIST_BUCKETS:
+            raise ValueError(
+                f"histogram state has {len(counts)} buckets, expected "
+                f"{_HIST_BUCKETS} (mixed framework versions?)")
+        h = cls(name)
+        h._counts = [int(c) for c in counts]
+        h._count = int(state["count"])
+        h._sum_ms = float(state["sum_ms"])
+        mn = state.get("min_ms")
+        h._min_ms = float("inf") if mn is None else float(mn)
+        h._max_ms = float(state.get("max_ms", 0.0))
+        return h
 
     def __repr__(self):
         return (f"Histogram({self.name}: n={self._count}, "
@@ -209,6 +249,19 @@ class MetricsRegistry:
             for k, v in h.snapshot().items():
                 out[f"{name}.{k}"] = v
         return out
+
+    def export_state(self) -> dict:
+        """JSON-serializable raw state: counters/timings/gauges plus each
+        histogram's bucket counts — what `/metrics.json` ships and
+        `telemetry.exposition.merge_states` sums across workers (snapshot()
+        percentiles cannot be merged; bucket counts can, exactly)."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            timings = {l: list(t) for l, t in self._timings.items()}
+            gauges = dict(self._gauges)
+            hists = list(self._hists.items())
+        return {"counters": counters, "timings": timings, "gauges": gauges,
+                "hists": {n: h.state() for n, h in hists}}
 
     def reset(self, prefix: Optional[str] = None) -> None:
         """Zero counters/timings/histograms/gauges (tests isolate scenarios

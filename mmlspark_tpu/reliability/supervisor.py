@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Callable, Optional, Sequence
 
+from ..telemetry.spans import get_tracer
 from ..utils.checkpoint import CheckpointManager
 from .faults import FaultInjector, InjectedFault
 from .metrics import reliability_metrics
@@ -183,11 +184,19 @@ class AsyncCheckpointWriter:
     def _write(self, step: int, payload: dict, prune_newer: bool,
                absorb: bool) -> None:
         t0 = time.perf_counter()
+        # lifecycle span (sync finals + async writer-thread writes alike):
+        # chaos/telemetry runs see every write attempt with its outcome
+        span = get_tracer().start_span(
+            "checkpoint.write", attrs={"step": step, "sync": not absorb})
         try:
             if self.faults is not None:
                 self.faults.perturb("train.ckpt.write")
             self.manager.save(step, payload, prune_newer=prune_newer)
+            if span is not None:
+                span.finish(ok=True)
         except Exception as e:  # noqa: BLE001 - async writes must not kill training
+            if span is not None:
+                span.finish(ok=False, error=type(e).__name__)
             self.metrics.inc("checkpoint.write.errors")
             logger.warning("checkpoint write for step %d failed (%s: %s)",
                            step, type(e).__name__, e)
@@ -288,6 +297,7 @@ class TrainingSupervisor:
         self.resumed_step = step
         self.metrics.inc("train.resumes")
         self.metrics.set_gauge("train.resume_step", step)
+        get_tracer().event("train.resume", step=step)
         logger.info("resumed training from checkpoint step %d", step)
         return step
 
@@ -319,9 +329,13 @@ class TrainingSupervisor:
                         raise SystemExit(0)
                     raise Preempted(step, self._preempt)
                 try:
-                    if self.faults is not None:
-                        self.faults.perturb(f"train.step{step}")
-                    out = self._call_step(step_fn, step)
+                    # step span: covers the fault site too, so an injected
+                    # step failure records error=<type> on ITS step before
+                    # the restart machinery engages
+                    with get_tracer().span("train.step", step=step):
+                        if self.faults is not None:
+                            self.faults.perturb(f"train.step{step}")
+                        out = self._call_step(step_fn, step)
                 except self.restart_on as e:
                     step, results = self._restart(e, seek)
                     continue
@@ -393,6 +407,8 @@ class TrainingSupervisor:
         assert self._last is not None
         last_step, payload, results = self._last
         self.metrics.inc("train.step_restarts")
+        get_tracer().event("train.restart", step=last_step,
+                           error=type(err).__name__)
         logger.warning("training step failed (%s: %s); restarting from "
                        "snapshot step %d", type(err).__name__, err, last_step)
         self.restore_fn({k: v for k, v in payload.items()
@@ -481,6 +497,8 @@ class TrainingSupervisor:
                 pass
         if preempted:
             self.metrics.inc("train.preempted")
+            get_tracer().event("train.preempted", step=step,
+                               signum=self._preempt)
             self._beat(step)
         else:
             self._beat(None)   # clean finish: next start is fresh

@@ -22,6 +22,23 @@ class _TimerParams:
         "disable_materialization",
         "when False, force host materialization before/after so the timing "
         "covers real work, not lazy views (Timer.scala:31-36)", True)
+    telemetry = Param(
+        "telemetry",
+        "record fit/transform timings as telemetry tracer spans "
+        "(stage.<Type>.<action>) instead of console prints — pipeline "
+        "stage timings then land in the same span log as serving/training "
+        "(docs/observability.md)", False)
+
+
+def _observe_stage(stage, action: str, seconds: float) -> bool:
+    """Telemetry sink for a stage timing: a completed span named
+    `stage.<Type>.<action>` under the active trace (or its own). Returns
+    whether the span was actually recorded — with sampling off the Timer
+    must NOT silently drop a timing the user asked for, so the caller
+    falls back to the console print."""
+    from ..telemetry.spans import get_tracer
+    return get_tracer().observe(f"stage.{type(stage).__name__}.{action}",
+                                seconds) is not None
 
 
 def _emit(stage, seconds: float, action: str, count, enabled: bool):
@@ -43,26 +60,40 @@ class Timer(Estimator, _TimerParams):
             self.set(stage=stage)
 
     def fit_with_time(self, t: Table):
+        model, msg, _recorded = self._fit_timed(t)
+        return model, msg
+
+    def _fit_timed(self, t: Table):
+        """(model, msg, span_recorded) — the flag is per-CALL, never stored
+        on the shared stage (concurrent fits must not race each other's
+        print-fallback decision)."""
         inner = self.stage
         if inner is None:
             raise ValueError("Timer: stage param is not set")
         count = None if self.disable_materialization else len(t.materialize())
+        recorded = False
         if isinstance(inner, Estimator):
             t0 = time.perf_counter()
             fitted = inner.fit(t)
             elapsed = time.perf_counter() - t0
             msg = f"{type(inner).__name__} fit in {elapsed}s"
             _emit(inner, elapsed, "fit", count, False)
+            recorded = (self.telemetry
+                        and _observe_stage(inner, "fit", elapsed))
         else:
             fitted, msg = inner, ""
         model = TimerModel(
             transformer=fitted, log_to_console=self.log_to_console,
-            disable_materialization=self.disable_materialization)
-        return model, msg
+            disable_materialization=self.disable_materialization,
+            telemetry=self.telemetry)
+        return model, msg, recorded
 
     def _fit(self, t: Table) -> "TimerModel":
-        model, msg = self.fit_with_time(t)
-        if msg and self.log_to_console:
+        model, msg, recorded = self._fit_timed(t)
+        # telemetry mode: the console line is replaced ONLY when a span was
+        # actually recorded — with sampling off, dropping both would lose
+        # the timing the user asked for
+        if msg and self.log_to_console and not recorded:
             print(msg)
         return model
 
@@ -72,6 +103,13 @@ class TimerModel(Model, _TimerParams):
     transformer = Param("transformer", "inner transformer to time", None)
 
     def transform_with_time(self, t: Table):
+        out, msg, _recorded = self._transform_timed(t)
+        return out, msg
+
+    def _transform_timed(self, t: Table):
+        """(out, msg, span_recorded) — per-call flag, see Timer._fit_timed
+        (a shared TimerModel transformed by concurrent serving workers
+        must not race the fallback decision through instance state)."""
         inner = self.transformer
         if inner is None:
             raise ValueError("TimerModel: transformer param is not set")
@@ -82,12 +120,15 @@ class TimerModel(Model, _TimerParams):
         if not self.disable_materialization:
             out = out.materialize()
         elapsed = time.perf_counter() - t0
-        return out, f"{type(inner).__name__} took {elapsed}s to transform" + (
+        recorded = (self.telemetry
+                    and _observe_stage(inner, "transform", elapsed))
+        msg = f"{type(inner).__name__} took {elapsed}s to transform" + (
             f" {count} rows" if count is not None else "")
+        return out, msg, recorded
 
     def _transform(self, t: Table) -> Table:
-        out, msg = self.transform_with_time(t)
+        out, msg, recorded = self._transform_timed(t)
         _logger.info(msg)
-        if self.log_to_console:
+        if self.log_to_console and not recorded:
             print(msg)
         return out

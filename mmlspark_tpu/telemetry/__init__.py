@@ -1,0 +1,54 @@
+"""Telemetry subsystem: request-scoped span tracing, cross-process metrics
+exposition, and profiling hooks (docs/observability.md).
+
+Three pillars:
+
+- **Spans** (`telemetry.spans`): `Tracer`/`Span` with contextvar parent
+  linkage, deterministic head sampling, a bounded ring buffer, JSONL
+  export, and `X-Trace-Id` propagation — one id follows a request from
+  serving ingress through the partition queue and compiled-plan transform
+  to the reply, and from `RegistryClient` posts into the registry.
+- **Exposition** (`telemetry.exposition`): Prometheus text + JSON
+  rendering of `reliability.metrics.MetricsRegistry`, mounted as
+  `/metrics` / `/metrics.json` on `ServingServer` and `ServiceRegistry`,
+  plus `scrape_cluster()` which pulls and exactly merges every registered
+  worker's snapshot (bucket-level histogram merge, not percentile
+  averaging).
+- **Hooks**: serving request path, `data.DevicePrefetcher`,
+  `TrainingSupervisor` step/checkpoint lifecycle, `fit_booster`
+  iterations, `utils.tracing.trace` device profiles (stamped with the
+  active trace id), and structured events for supervisor
+  restarts/preemptions and `FaultInjector` firings — chaos runs read as
+  one causally-ordered event log.
+
+Sampling defaults OFF (env `MMLSPARK_TPU_TRACE_SAMPLE`, or
+`telemetry.configure(sample=...)`): at 0% the hot-path cost is a single
+compare per site (`BENCH_MODE=telemetry` pins the off/1%/full A/B).
+"""
+from .spans import (CAPACITY_ENV, REQUEST_ID_HEADER, SAMPLE_ENV, Span,
+                    SpanContext, TRACE_HEADER, Tracer, configure, get_tracer,
+                    head_sampled, new_id, parse_trace_header, read_jsonl)
+
+# exposition re-exports are LAZY: spans.py is the stdlib-only layer every
+# subsystem imports (`from ..telemetry.spans import get_tracer`), and that
+# import executes this __init__ — an eager exposition import would pull
+# reliability.metrics into every low layer and re-open the circular-import
+# door spans.py exists to close.
+_EXPOSITION_NAMES = frozenset((
+    "ClusterSnapshot", "PROM_CONTENT_TYPE", "merge_states",
+    "metrics_http_response", "render_prometheus", "scrape_cluster",
+    "state_snapshot"))
+
+
+def __getattr__(name):
+    if name in _EXPOSITION_NAMES:
+        from . import exposition
+        return getattr(exposition, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
+           "head_sampled", "new_id", "parse_trace_header", "read_jsonl",
+           "TRACE_HEADER", "REQUEST_ID_HEADER", "SAMPLE_ENV", "CAPACITY_ENV",
+           "render_prometheus", "metrics_http_response", "merge_states",
+           "state_snapshot", "scrape_cluster", "ClusterSnapshot",
+           "PROM_CONTENT_TYPE"]

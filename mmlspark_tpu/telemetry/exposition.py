@@ -1,0 +1,188 @@
+"""Cross-process metrics exposition: Prometheus text rendering, a
+machine-mergeable JSON form, and a cluster-wide scrape helper.
+
+Every process's `reliability.metrics.MetricsRegistry` is in-memory only;
+this module gives it the two standard export surfaces a production serving
+stack needs (PAPERS.md: production monitoring stacks):
+
+- `render_prometheus(registry)` — the Prometheus text format (0.0.4).
+  Counters render as `<name>_total`, gauges plain, wall-clock timings as a
+  `_seconds_total` / `_calls_total` pair, and histograms with CUMULATIVE
+  `_bucket{le="..."}` lines in SECONDS (the Prometheus unit convention;
+  our buckets are stored in ms and divided out here). The original dotted
+  metric name rides the `# HELP` line, so greps for `serving.request.e2e`
+  find its exposition block.
+- `/metrics` + `/metrics.json` are mounted on `ServingServer` (both
+  transports) and `ServiceRegistry` via `metrics_http_response` — one
+  implementation, three mounts.
+- `scrape_cluster(registry_address)` — pulls `/metrics.json` from every
+  worker registered in the `ServiceRegistry` and merges them EXACTLY:
+  counters/timings sum, histogram bucket counts sum elementwise (all
+  histograms share the module-level geometric bounds), and percentiles are
+  recomputed from the merged buckets — never averaged across workers.
+  Gauges are last-value signals with no cross-process meaning, so the
+  merge keeps `max` (worst queue depth wins) — documented, not silent.
+"""
+from __future__ import annotations
+
+import json
+import re
+import urllib.request
+from typing import NamedTuple, Optional
+
+from ..reliability.metrics import (Histogram, MetricsRegistry,
+                                   histogram_bounds_ms, reliability_metrics)
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(name: str) -> str:
+    """Sanitize a dotted metric name to the Prometheus grammar."""
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else f"{v:.9g}"
+
+
+def render_prometheus(registry=None, state: Optional[dict] = None) -> str:
+    """Render a registry (default: the process-wide `reliability_metrics`)
+    or a raw `export_state()` dict as Prometheus text."""
+    if state is None:
+        reg = registry if registry is not None else reliability_metrics
+        state = reg.export_state()
+    bounds = histogram_bounds_ms()
+    lines: list = []
+    for name in sorted(state.get("counters", {})):
+        pn = prom_name(name) + "_total"
+        lines.append(f"# HELP {pn} {name}")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt(state['counters'][name])}")
+    for name in sorted(state.get("timings", {})):
+        total, count = state["timings"][name]
+        pn = prom_name(name)
+        lines.append(f"# HELP {pn}_seconds_total {name} (wall-clock sink)")
+        lines.append(f"# TYPE {pn}_seconds_total counter")
+        lines.append(f"{pn}_seconds_total {_fmt(total)}")
+        lines.append(f"# TYPE {pn}_calls_total counter")
+        lines.append(f"{pn}_calls_total {_fmt(count)}")
+    for name in sorted(state.get("gauges", {})):
+        pn = prom_name(name)
+        lines.append(f"# HELP {pn} {name}")
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(state['gauges'][name])}")
+    for name in sorted(state.get("hists", {})):
+        h = state["hists"][name]
+        pn = prom_name(name) + "_seconds"
+        lines.append(f"# HELP {pn} {name} latency histogram")
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        counts = h["counts"]
+        for i, bound_ms in enumerate(bounds):
+            cum += counts[i]
+            lines.append(f'{pn}_bucket{{le="{_fmt(bound_ms / 1000.0)}"}} '
+                         f"{cum}")
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum {_fmt(h['sum_ms'] / 1000.0)}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def metrics_http_response(path: str, registry=None) -> tuple:
+    """(status, payload_bytes, content_type) for a `/metrics[.json]` GET —
+    the shared handler body `ServingServer` and `ServiceRegistry` mount."""
+    reg = registry if registry is not None else reliability_metrics
+    if path.startswith("/metrics.json"):
+        return 200, json.dumps(reg.export_state()).encode(), \
+            "application/json"
+    return 200, render_prometheus(reg).encode(), PROM_CONTENT_TYPE
+
+
+# ---------------------------------------------------------------- merging
+def merge_states(states: list) -> dict:
+    """Merge raw `export_state()` dicts: counters/timings sum, histogram
+    buckets sum elementwise, gauges keep max (see module docstring)."""
+    merged = {"counters": {}, "timings": {}, "gauges": {}, "hists": {}}
+    for st in states:
+        for name, v in st.get("counters", {}).items():
+            merged["counters"][name] = merged["counters"].get(name, 0) + v
+        for name, (total, count) in st.get("timings", {}).items():
+            t = merged["timings"].setdefault(name, [0.0, 0])
+            t[0] += total
+            t[1] += count
+        for name, v in st.get("gauges", {}).items():
+            prev = merged["gauges"].get(name)
+            merged["gauges"][name] = v if prev is None else max(prev, v)
+        for name, h in st.get("hists", {}).items():
+            m = merged["hists"].get(name)
+            if m is None:
+                merged["hists"][name] = {
+                    "counts": list(h["counts"]), "count": h["count"],
+                    "sum_ms": h["sum_ms"], "min_ms": h.get("min_ms"),
+                    "max_ms": h.get("max_ms", 0.0)}
+                continue
+            m["counts"] = [a + b for a, b in zip(m["counts"], h["counts"])]
+            m["count"] += h["count"]
+            m["sum_ms"] += h["sum_ms"]
+            mins = [x for x in (m.get("min_ms"), h.get("min_ms"))
+                    if x is not None]
+            m["min_ms"] = min(mins) if mins else None
+            m["max_ms"] = max(m.get("max_ms", 0.0), h.get("max_ms", 0.0))
+    return merged
+
+
+def state_snapshot(state: dict) -> dict:
+    """Flatten a raw state into the same key shape
+    `MetricsRegistry.snapshot()` produces — histogram percentiles are
+    recomputed from the (possibly merged) bucket counts."""
+    out = dict(state.get("counters", {}))
+    for label, (total, count) in state.get("timings", {}).items():
+        out[f"{label}.seconds"] = total
+        out[f"{label}.count"] = count
+    out.update(state.get("gauges", {}))
+    for name, h in state.get("hists", {}).items():
+        for k, v in Histogram.from_state(name, h).snapshot().items():
+            out[f"{name}.{k}"] = v
+    return out
+
+
+class ClusterSnapshot(NamedTuple):
+    """`scrape_cluster` result: the exactly-merged flat snapshot plus each
+    worker's raw state for per-host drill-down."""
+    merged: dict
+    workers: list   # [(ServiceInfo, raw state dict), ...]
+
+
+def scrape_cluster(registry_address: str, name: Optional[str] = None,
+                   timeout: float = 10.0,
+                   skip_unreachable: bool = True) -> ClusterSnapshot:
+    """Pull `/metrics.json` from every worker the `ServiceRegistry` at
+    `registry_address` knows (optionally one service `name`) and merge.
+    A worker that died between registering and the scrape is skipped (its
+    numbers are gone either way); pass `skip_unreachable=False` to raise
+    instead."""
+    from ..io.registry import ServiceInfo, list_services
+    if name is not None:
+        infos = list_services(registry_address, name, timeout=timeout)
+    else:
+        with urllib.request.urlopen(registry_address + "/services",
+                                    timeout=timeout) as resp:
+            infos = [ServiceInfo(**d) for d in json.loads(resp.read())]
+    workers = []
+    for info in infos:
+        try:
+            with urllib.request.urlopen(info.address + "/metrics.json",
+                                        timeout=timeout) as resp:
+                workers.append((info, json.loads(resp.read())))
+        except (OSError, ValueError) as e:
+            if not skip_unreachable:
+                raise RuntimeError(
+                    f"scrape of {info.address} failed: {e}") from e
+    merged = state_snapshot(merge_states([st for _, st in workers]))
+    merged["telemetry.scrape.workers"] = len(workers)
+    return ClusterSnapshot(merged=merged, workers=workers)

@@ -1,0 +1,401 @@
+"""Request-scoped span tracing: one id follows a request across threads,
+processes, and HTTP hops.
+
+Role analog: the reference stack leans on Spark's own event log plus ad-hoc
+`log*` calls; a serving system meant for heavy traffic needs Dapper-style
+spans — a *trace id* minted at ingress, *span ids* for each timed region,
+parent linkage so the tree reconstructs, and propagation headers so the id
+survives process boundaries (PAPERS.md: production serving/monitoring
+stacks). This module is intentionally stdlib-only — it sits UNDER
+`reliability`, `io`, `data`, and the model layers, so it must import none
+of them.
+
+Design:
+
+- `Tracer` holds a bounded ring buffer (`collections.deque(maxlen=...)`) of
+  FINISHED spans — a day of traffic cannot grow memory; overflow increments
+  a `dropped` counter instead of blocking anything.
+- Parent linkage rides a `contextvars.ContextVar`, so spans nest correctly
+  across threads spawned with `contextvars.copy_context` and across the
+  same thread's call stack; worker threads that process another thread's
+  request activate its context explicitly (`tracer.use(span)`).
+- **Deterministic head sampling**: the keep/drop decision is made ONCE at
+  the trace head and is a pure function of `(trace_id, sample_rate)` —
+  `crc32(trace_id)/2^32 < rate` — so every process that sees the same
+  trace id independently reaches the same decision (no sampled-flag drift
+  between hosts on the same trace). A propagated `X-Trace-Id` header also
+  carries the decision explicitly, which wins over recomputation.
+- Propagation: `X-Trace-Id: <trace_id>:<parent_span_id>:<0|1>`. A bare
+  value with no `:` is accepted as a sampled trace id (curl-friendly).
+- Zero overhead disabled: `sample_rate == 0` with no incoming context makes
+  `start_span` return `None` after one float compare; every instrumentation
+  site branches on `is not None`.
+- JSONL export: `export_jsonl(path)` writes one JSON object per finished
+  span, in `seq` order — a process-wide monotonic sequence number that
+  makes single-process event logs causally ordered even when wall clocks
+  are too coarse to order them.
+
+Events (`tracer.event(name, **attrs)`) are zero-duration spans with
+`kind="event"` — supervisor restarts/preemptions and FaultInjector firings
+land here, so a chaos run reads as one ordered narrative.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import itertools
+import json
+import os
+import threading
+import time
+import uuid
+import zlib
+from collections import deque
+from typing import Callable, NamedTuple, Optional
+
+TRACE_HEADER = "X-Trace-Id"
+REQUEST_ID_HEADER = "X-Request-Id"
+# env knobs: sampling rate for the process-default tracer (0 = off, the
+# production-safe default; serving tests/benches opt in) and ring capacity
+SAMPLE_ENV = "MMLSPARK_TPU_TRACE_SAMPLE"
+CAPACITY_ENV = "MMLSPARK_TPU_TRACE_CAPACITY"
+
+
+class SpanContext(NamedTuple):
+    """The propagated identity of a trace position: enough to parent a new
+    span (local or remote) and to carry the head-sampling decision."""
+    trace_id: str
+    span_id: str
+    sampled: bool
+
+    def header_value(self) -> str:
+        return f"{self.trace_id}:{self.span_id}:{1 if self.sampled else 0}"
+
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "mmlspark_tpu_trace_ctx", default=None)
+
+
+def new_id() -> str:
+    """16-hex span/trace id (uuid4-derived: unique without coordination)."""
+    return uuid.uuid4().hex[:16]
+
+
+def head_sampled(trace_id: str, rate: float) -> bool:
+    """The deterministic head-sampling decision: a pure function of the
+    trace id, so independent processes agree without a propagated flag."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (zlib.crc32(trace_id.encode()) & 0xFFFFFFFF) / 4294967296.0 < rate
+
+
+def parse_trace_header(value: str) -> Optional[SpanContext]:
+    """`trace:parent_span:flag` (or a bare trace id, treated as sampled)."""
+    if not value:
+        return None
+    parts = value.strip().split(":")
+    if len(parts) == 1:
+        return SpanContext(parts[0], "", True)
+    if len(parts) >= 3:
+        return SpanContext(parts[0], parts[1], parts[2] not in ("0", ""))
+    return SpanContext(parts[0], parts[1], True)
+
+
+class Span:
+    """One timed region. Created by `Tracer.start_span` (never directly);
+    lands in the tracer's ring buffer when `finish()` is called. Safe to
+    finish from a different thread than the one that started it; finish is
+    idempotent (serving's reply/expiry race may touch a span twice)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_s",
+                 "attrs", "duration_ms", "kind", "_t0", "_tracer",
+                 "_finished")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: str, parent_id: Optional[str],
+                 attrs: Optional[dict]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_s = time.time()
+        self._t0 = time.perf_counter()
+        self.attrs = dict(attrs) if attrs else {}
+        self.duration_ms = 0.0
+        self.kind = "span"
+        self._tracer = tracer
+        self._finished = False
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id, True)
+
+    def finish(self, **attrs) -> None:
+        # test-and-set under the tracer lock: the serving reply/expiry race
+        # can call finish from two threads at once, and an unsynchronized
+        # flag would append the span twice with conflicting statuses
+        with self._tracer._lock:
+            if self._finished:
+                return
+            self._finished = True
+        self.duration_ms = (time.perf_counter() - self._t0) * 1000.0
+        if attrs:
+            self.attrs.update(attrs)
+        self._tracer._append(self)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start": self.start_s, "duration_ms": self.duration_ms,
+                "kind": self.kind, "attrs": self.attrs}
+
+    def __repr__(self):
+        return (f"Span({self.name} trace={self.trace_id} id={self.span_id} "
+                f"{self.duration_ms:.3f}ms)")
+
+
+class Tracer:
+    """Span factory + bounded ring of finished spans. Thread-safe."""
+
+    def __init__(self, sample: Optional[float] = None,
+                 capacity: Optional[int] = None):
+        if sample is None:
+            sample = float(os.environ.get(SAMPLE_ENV, "0") or 0)
+        if capacity is None:
+            capacity = int(os.environ.get(CAPACITY_ENV, "4096") or 4096)
+        self._lock = threading.Lock()
+        self._sample = float(sample)
+        self._spans: deque = deque(maxlen=max(int(capacity), 1))
+        self._dropped = 0
+        self._seq = itertools.count()
+
+    # -- configuration -------------------------------------------------------
+    @property
+    def sample_rate(self) -> float:
+        return self._sample
+
+    def configure(self, sample: Optional[float] = None,
+                  capacity: Optional[int] = None) -> "Tracer":
+        with self._lock:
+            if sample is not None:
+                self._sample = float(sample)
+            if capacity is not None:
+                self._spans = deque(self._spans,
+                                    maxlen=max(int(capacity), 1))
+        return self
+
+    # -- context propagation -------------------------------------------------
+    def current(self) -> Optional[SpanContext]:
+        return _current.get()
+
+    def extract(self, headers: Optional[dict]) -> Optional[SpanContext]:
+        """Pull a SpanContext out of an HTTP header dict, case-insensitive:
+        serving's selector transport lowercases keys, http.client sends
+        them as given, and urllib CAPITALIZES to 'X-trace-id' — all three
+        spellings must resolve or propagation silently drops."""
+        if not headers:
+            return None
+        value = headers.get(TRACE_HEADER) or headers.get(TRACE_HEADER.lower())
+        if value is None:
+            low = TRACE_HEADER.lower()
+            for k, v in headers.items():
+                if isinstance(k, str) and k.lower() == low:
+                    value = v
+                    break
+        if value is None:
+            return None
+        return parse_trace_header(value)
+
+    def inject(self, headers: Optional[dict] = None,
+               ctx: Optional[SpanContext] = None) -> dict:
+        """Add the active (or given) SAMPLED context to an outbound header
+        dict; returns {} / the dict unchanged when there is nothing to
+        propagate — callers can merge unconditionally."""
+        ctx = ctx if ctx is not None else _current.get()
+        if headers is None:
+            headers = {}
+        if ctx is not None and ctx.sampled:
+            headers[TRACE_HEADER] = ctx.header_value()
+        return headers
+
+    @contextlib.contextmanager
+    def use(self, span_or_ctx):
+        """Activate a span/context on THIS thread (worker threads processing
+        another thread's request adopt its trace here)."""
+        ctx = (span_or_ctx.context if isinstance(span_or_ctx, Span)
+               else span_or_ctx)
+        token = _current.set(ctx)
+        try:
+            yield ctx
+        finally:
+            _current.reset(token)
+
+    # -- span creation -------------------------------------------------------
+    def start_span(self, name: str, parent=_current,
+                   trace_id: Optional[str] = None,
+                   span_id: Optional[str] = None,
+                   attrs: Optional[dict] = None) -> Optional[Span]:
+        """Begin a span; returns None when the trace is unsampled (callers
+        branch on `is not None` — the disabled path is one compare).
+
+        `parent` defaults to the ambient contextvar; pass an explicit Span /
+        SpanContext / None (None forces a new trace head). `trace_id` /
+        `span_id` override generation — serving uses the ingress request id
+        as both the fresh trace id and the root span id so the id a client
+        sees IS the trace id."""
+        if parent is _current:
+            parent = _current.get()
+        if isinstance(parent, Span):
+            parent = parent.context
+        if parent is not None:
+            if not parent.sampled:
+                return None
+            tid, pid = parent.trace_id, parent.span_id or None
+        else:
+            if self._sample <= 0.0:
+                return None
+            tid = trace_id if trace_id is not None else new_id()
+            if not head_sampled(tid, self._sample):
+                return None
+            pid = None
+        return Span(self, name, tid, span_id or new_id(), pid, attrs)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Context-manager span, activated on the current thread; yields the
+        Span or None. An escaping exception is recorded as `error=<type>`
+        before re-raising — restarted/retried work stays visible."""
+        sp = self.start_span(name, attrs=attrs or None)
+        if sp is None:
+            yield None
+            return
+        token = _current.set(sp.context)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.finish(error=type(e).__name__)
+            raise
+        finally:
+            _current.reset(token)
+            sp.finish()
+
+    def trace(self, name: Optional[str] = None, **attrs) -> Callable:
+        """Decorator form: `@tracer.trace("stage.encode")`."""
+        def deco(fn):
+            label = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def wrapped(*args, **kwargs):
+                with self.span(label, **attrs):
+                    return fn(*args, **kwargs)
+            return wrapped
+        return deco
+
+    def record(self, name: str, parent=_current, duration_ms: float = 0.0,
+               start_s: Optional[float] = None, kind: str = "span",
+               attrs: Optional[dict] = None) -> Optional[dict]:
+        """Append an already-measured span post-hoc (batch workers stamp one
+        per request AFTER the shared transform ran; `observe` sinks land
+        here). Sampling rules match start_span; returns the recorded dict
+        or None."""
+        sp = self.start_span(name, parent=parent, attrs=attrs)
+        if sp is None:
+            return None
+        sp.kind = kind
+        # a post-hoc span is recorded at the END of its interval: backdate
+        # the start by the duration so children sit INSIDE their parent on
+        # a timeline instead of dangling past its end
+        sp.start_s = (start_s if start_s is not None
+                      else sp.start_s - float(duration_ms) / 1000.0)
+        sp.duration_ms = float(duration_ms)
+        sp._finished = True
+        self._append(sp)
+        return sp.to_dict()
+
+    def event(self, name: str, parent=_current, **attrs) -> Optional[dict]:
+        """Point-in-time structured event (kind="event"): recorded under the
+        active sampled trace, or as a trace of its own when sampling is on —
+        supervisor preemptions and injected faults must appear in the chaos
+        log even when no request context is active."""
+        return self.record(name, parent=parent, duration_ms=0.0,
+                           kind="event", attrs=attrs or None)
+
+    def observe(self, label: str, seconds: float) -> Optional[dict]:
+        """`(label, seconds)` sink — the same signature as
+        `MetricsRegistry.observe`, so `utils.tracing.wall_clock(...,
+        sink=tracer.observe)` turns timed blocks into spans. Returns the
+        recorded span dict, or None when sampling dropped it — callers
+        that REPLACE another output with the span (Timer's print) use
+        this to fall back instead of losing the timing."""
+        return self.record(label, duration_ms=seconds * 1000.0)
+
+    # -- ring buffer / export ------------------------------------------------
+    def _append(self, span: Span) -> None:
+        d = span.to_dict()
+        with self._lock:
+            d["seq"] = next(self._seq)
+            d["pid"] = os.getpid()
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(d)
+
+    def finished(self, name: Optional[str] = None) -> list:
+        """Finished span dicts in seq (causal) order; `name` filters."""
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s["name"] == name]
+        return out
+
+    def export_jsonl(self, path: str, clear: bool = False) -> int:
+        """Write the ring to a JSONL file (one span per line, seq order);
+        returns the number of spans written."""
+        spans = self.finished()
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s) + "\n")
+        if clear:
+            self.clear()
+        return len(spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"spans": len(self._spans), "dropped": self._dropped,
+                    "capacity": self._spans.maxlen,
+                    "sample_rate": self._sample}
+
+
+def read_jsonl(path: str) -> list:
+    """Load a JSONL export back into span dicts (test/analysis helper)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# Process-wide default: instrumentation sites record here unless handed a
+# private tracer (mirrors reliability_metrics). Sampling comes from
+# MMLSPARK_TPU_TRACE_SAMPLE (default 0 = off; `configure(sample=...)`
+# flips it at runtime).
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def configure(sample: Optional[float] = None,
+              capacity: Optional[int] = None) -> Tracer:
+    """Configure the process-default tracer (sampling rate / ring size)."""
+    return _default.configure(sample=sample, capacity=capacity)

@@ -6,10 +6,18 @@ code blocks).
 TensorBoard/Perfetto and shows per-op device time, the ground truth for the
 fusion/HBM questions this framework's perf work keeps asking. annotate()
 marks named regions inside a trace.
+
+Telemetry integration (docs/observability.md): when a request/span context
+is active, `trace()` stamps the profile directory with the trace id
+(`trace_context.json`) and records a `device.profile` span — a slow request
+in the span log links straight to the device profile that explains it.
+`wall_clock(..., tracer=...)` routes a timed block into the telemetry
+tracer as a span instead of printing.
 """
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import time
 
@@ -22,13 +30,29 @@ def trace(log_dir: str, create_perfetto_link: bool = False):
             model.fit(table)
     """
     import jax
+    from ..telemetry.spans import get_tracer
     os.makedirs(log_dir, exist_ok=True)
+    tracer = get_tracer()
+    span = tracer.start_span("device.profile", attrs={"log_dir": log_dir})
     jax.profiler.start_trace(log_dir,
                              create_perfetto_link=create_perfetto_link)
     try:
         yield log_dir
     finally:
         jax.profiler.stop_trace()
+        ctx = span.context if span is not None else tracer.current()
+        if ctx is not None:
+            # stamp the profile with the active trace id so the on-disk
+            # artifact and the span log cross-reference each other
+            try:
+                with open(os.path.join(log_dir,
+                                       "trace_context.json"), "w") as f:
+                    json.dump({"trace_id": ctx.trace_id,
+                               "span_id": ctx.span_id}, f)
+            except OSError:
+                pass   # profile capture outranks the stamp
+        if span is not None:
+            span.finish()
 
 
 def annotate(name: str):
@@ -38,14 +62,27 @@ def annotate(name: str):
 
 
 @contextlib.contextmanager
-def wall_clock(label: str, sink=None):
-    """Host-side wall-clock for a block; `sink(label, seconds)` or print."""
+def wall_clock(label: str, sink=None, tracer=None):
+    """Host-side wall-clock for a block; `sink(label, seconds)` or print.
+
+    `tracer` routes the timing into the telemetry span log instead of the
+    console: pass a `telemetry.Tracer` (or `True` for the process default)
+    and the block lands as a span named `label` — the Timer stage's
+    telemetry mode and ad-hoc pipeline timings share this path."""
     t0 = time.perf_counter()
     try:
         yield
     finally:
         dt = time.perf_counter() - t0
+        recorded = None
+        if tracer is not None:
+            if tracer is True:
+                from ..telemetry.spans import get_tracer
+                tracer = get_tracer()
+            recorded = tracer.observe(label, dt)
         if sink is not None:
             sink(label, dt)
-        else:
+        elif tracer is None or recorded is None:
+            # an unsampled span records nothing — a timing the caller
+            # asked for must not vanish, so fall back to the print
             print(f"{label}: {dt:.4f}s")

@@ -1,0 +1,738 @@
+"""Telemetry subsystem (ISSUE 5): request-scoped spans, cross-process
+metrics exposition, profiling hooks.
+
+Covers the acceptance chain end to end: one trace id visible at the client
+(X-Request-Id), in the ingress span, in the partition-worker transform
+span, and in the JSONL export — including across a REAL subprocess serving
+worker — plus Prometheus exposition on a live ServingServer, exact
+cluster-merge semantics, MetricsRegistry under racing writers, and the
+supervisor/fault-injector structured event log."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.reliability.metrics import (Histogram, MetricsRegistry,
+                                              reliability_metrics)
+from mmlspark_tpu.telemetry import (Tracer, merge_states, parse_trace_header,
+                                    render_prometheus, scrape_cluster,
+                                    state_snapshot)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tracer():
+    """The process-default tracer, sampling ON for the test, restored off
+    after (0 is the production default — serving hot paths must not record
+    unless asked)."""
+    tr = telemetry.get_tracer()
+    tr.configure(sample=1.0, capacity=4096)
+    tr.clear()
+    yield tr
+    tr.configure(sample=0.0)
+    tr.clear()
+
+
+def _echo_serving(**server_kw):
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+
+    server = ServingServer(num_partitions=1, **server_kw).start()
+
+    def transform(bodies):
+        return [{"echo": json.loads(b)["x"]} for b in bodies]
+
+    query = ServingQuery(server, transform, mode="continuous").start()
+    return server, query
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    resp = urllib.request.urlopen(req, timeout=15)
+    return resp, json.loads(resp.read())
+
+
+# ---------------------------------------------------------------- spans core
+def test_span_nesting_and_context_linkage(tracer):
+    with tracer.span("outer", layer=1) as outer:
+        assert tracer.current().span_id == outer.span_id
+        with tracer.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    names = [s["name"] for s in tracer.finished()]
+    assert names == ["inner", "outer"]     # children finish first
+    seqs = [s["seq"] for s in tracer.finished()]
+    assert seqs == sorted(seqs)            # causal order is the seq order
+
+
+def test_span_decorator_and_error_attr(tracer):
+    @tracer.trace("worker.fn")
+    def fn(x):
+        return x * 2
+
+    assert fn(3) == 6
+    assert tracer.finished("worker.fn")[0]["duration_ms"] >= 0.0
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("x")
+    assert tracer.finished("boom")[0]["attrs"]["error"] == "ValueError"
+
+
+def test_head_sampling_is_deterministic_and_proportional():
+    ids = [f"trace-{i}" for i in range(400)]
+    a = Tracer(sample=0.5)
+    b = Tracer(sample=0.5)
+    da = [a.start_span("s", parent=None, trace_id=t) is not None for t in ids]
+    db = [b.start_span("s", parent=None, trace_id=t) is not None for t in ids]
+    # two independent tracers reach the SAME keep/drop decision per id —
+    # the property that keeps multi-process traces whole without a flag
+    assert da == db
+    assert 100 < sum(da) < 300             # roughly the asked-for rate
+    assert all(Tracer(sample=1.0).start_span("s", parent=None, trace_id=t)
+               is not None for t in ids[:10])
+    assert all(Tracer(sample=0.0).start_span("s", parent=None, trace_id=t)
+               is None for t in ids[:10])
+
+
+def test_unsampled_parent_suppresses_children():
+    tr = Tracer(sample=1.0)
+    ctx = telemetry.SpanContext("t1", "s1", False)
+    assert tr.start_span("child", parent=ctx) is None
+
+
+def test_ring_buffer_bounded_with_drop_count():
+    tr = Tracer(sample=1.0, capacity=16)
+    for i in range(50):
+        tr.record("s", parent=None, duration_ms=1.0)
+    st = tr.stats()
+    assert st["spans"] == 16 and st["dropped"] == 34
+    # the ring keeps the NEWEST spans
+    assert [s["seq"] for s in tr.finished()] == list(range(34, 50))
+
+
+def test_header_inject_extract_roundtrip(tracer):
+    with tracer.span("req") as sp:
+        headers = tracer.inject({"Content-Type": "application/json"})
+    ctx = tracer.extract(headers)
+    assert ctx.trace_id == sp.trace_id and ctx.span_id == sp.span_id
+    assert ctx.sampled
+    # lowercased header dicts (the selector transport) parse too
+    low = {k.lower(): v for k, v in headers.items()}
+    assert tracer.extract(low) == ctx
+    # bare id (curl-friendly) is a sampled trace with no parent span
+    bare = parse_trace_header("abc123")
+    assert bare.trace_id == "abc123" and bare.sampled and bare.span_id == ""
+    assert tracer.inject({}) == {}         # no active ctx -> no header
+
+
+def test_extract_handles_urllib_capitalized_header(tracer):
+    """urllib capitalizes header names to 'X-trace-id' on the wire; the
+    threading transport and registry handler hand extract() that casing
+    verbatim — it must still resolve (regression: propagation was dead for
+    every urllib client)."""
+    value = "t1:s1:1"
+    for spelling in ("X-Trace-Id", "x-trace-id", "X-trace-id"):
+        ctx = tracer.extract({spelling: value})
+        assert ctx == telemetry.SpanContext("t1", "s1", True), spelling
+
+
+def test_span_finish_race_appends_once(tracer):
+    """finish() from two threads at once (the serving reply/expiry race)
+    must land exactly ONE span in the ring — first caller wins."""
+    for _ in range(50):
+        tracer.clear()
+        sp = tracer.start_span("raced", parent=None)
+        barrier = threading.Barrier(2)
+
+        def fin(status):
+            barrier.wait()
+            sp.finish(status=status)
+
+        ts = [threading.Thread(target=fin, args=(s,)) for s in (200, 504)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(tracer.finished("raced")) == 1
+
+
+def test_posthoc_record_backdates_start(tracer):
+    """record()/observe() happen at the END of the measured interval; the
+    span's start must be backdated by the duration so children sit INSIDE
+    their parent on a timeline."""
+    with tracer.span("parent") as sp:
+        t_end = time.time()
+        tracer.record("child", duration_ms=5000.0)
+    child = tracer.finished("child")[0]
+    parent = tracer.finished("parent")[0]
+    assert child["start"] == pytest.approx(t_end - 5.0, abs=0.5)
+    # the child's interval nests inside the parent's
+    assert child["start"] + child["duration_ms"] / 1000.0 <= \
+        parent["start"] + parent["duration_ms"] / 1000.0 + 0.5
+    # explicit start_s still wins
+    tracer.record("pinned", duration_ms=1000.0, start_s=123.0)
+    assert tracer.finished("pinned")[0]["start"] == 123.0
+
+
+def test_jsonl_export_roundtrip(tracer, tmp_path):
+    with tracer.span("a"):
+        pass
+    tracer.event("e", k=1)
+    path = str(tmp_path / "spans.jsonl")
+    assert tracer.export_jsonl(path) == 2
+    spans = telemetry.read_jsonl(path)
+    assert [s["name"] for s in spans] == ["a", "e"]
+    assert spans[1]["kind"] == "event" and spans[1]["attrs"] == {"k": 1}
+    assert all(s["pid"] == os.getpid() for s in spans)
+
+
+def test_observe_sink_and_wall_clock(tracer, capsys):
+    from mmlspark_tpu.utils import tracing
+    with tracing.wall_clock("stage.block", tracer=tracer):
+        pass
+    assert capsys.readouterr().out == ""   # span replaced the print
+    rec = tracer.finished("stage.block")
+    assert len(rec) == 1 and rec[0]["duration_ms"] >= 0.0
+
+
+# ------------------------------------------------------- metrics satellites
+def test_histogram_snapshot_sum_and_mean():
+    h = Histogram("t")
+    for v in (1.0, 2.0, 3.0, 10.0):
+        h.observe_ms(v)
+    snap = h.snapshot()
+    assert snap["sum"] == pytest.approx(16.0)
+    assert snap["mean"] == pytest.approx(4.0)
+    # existing keys stay stable
+    assert snap["count"] == 4 and snap["mean_ms"] == snap["mean"]
+    assert {"p50", "p95", "p99"} <= set(snap)
+
+
+def test_histogram_state_roundtrip_and_merge():
+    a, b = Histogram("x"), Histogram("x")
+    for v in (0.5, 1.0, 2.0):
+        a.observe_ms(v)
+    for v in (100.0, 200.0):
+        b.observe_ms(v)
+    merged = merge_states([{"hists": {"x": a.state()}},
+                           {"hists": {"x": b.state()}}])
+    m = Histogram.from_state("x", merged["hists"]["x"])
+    assert m.count == 5
+    assert m.snapshot()["sum"] == pytest.approx(303.5)
+    # percentiles recomputed from merged BUCKETS, not averaged: the p99
+    # must land near b's tail, which any percentile-averaging would sink
+    assert m.percentile(99.0) == pytest.approx(200.0, rel=0.1)
+
+
+def test_metrics_registry_concurrent_writers_race_reset():
+    """Satellite: counter inc + histogram observe + reset(prefix) racing
+    from concurrent writers must neither crash nor corrupt unrelated
+    names; a post-quiesce deterministic phase pins exact totals."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors: list = []
+
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except Exception as e:  # noqa: BLE001 - surfaced to the assert
+                errors.append(e)
+        return run
+
+    threads = [
+        threading.Thread(target=guard(lambda: reg.inc("hot.count"))),
+        threading.Thread(target=guard(lambda: reg.inc("keep.count"))),
+        threading.Thread(target=guard(
+            lambda: reg.observe_ms("hot.lat", 1.0))),
+        threading.Thread(target=guard(
+            lambda: reg.observe("hot.wall", 0.001))),
+        threading.Thread(target=guard(lambda: reg.reset("hot."))),
+        threading.Thread(target=guard(lambda: reg.snapshot())),
+        threading.Thread(target=guard(lambda: reg.export_state())),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    assert not errors, errors[:3]
+    # names outside the reset prefix survived the race
+    assert reg.get("keep.count") > 0
+
+    # deterministic phase: no reset racing -> totals are exact
+    reg.reset()
+    workers = [threading.Thread(target=lambda: [
+        (reg.inc("exact.count"), reg.observe_ms("exact.lat", 1.0))
+        for _ in range(1000)]) for _ in range(4)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    assert reg.get("exact.count") == 4000
+    assert reg.histogram("exact.lat").count == 4000
+
+
+# ------------------------------------------------------------- exposition
+def test_prometheus_render_shapes():
+    reg = MetricsRegistry()
+    reg.inc("serving.shed_requests", 3)
+    reg.set_gauge("serving.queue_depth", 7)
+    reg.observe("replay", 0.013)
+    for v in (0.5, 1.0, 2.0, 400.0):
+        reg.observe_ms("serving.request.e2e", v)
+    text = render_prometheus(reg)
+    # the dotted name is findable (HELP line), the sanitized name carries
+    # the series, buckets are cumulative in SECONDS and end at +Inf
+    assert "serving.request.e2e" in text
+    assert "serving_shed_requests_total 3" in text
+    assert "serving_queue_depth 7" in text
+    assert "replay_seconds_total 0.013" in text
+    assert "replay_calls_total 1" in text
+    assert 'serving_request_e2e_seconds_bucket{le="+Inf"} 4' in text
+    assert "serving_request_e2e_seconds_count 4" in text
+    cum = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+           if line.startswith("serving_request_e2e_seconds_bucket")]
+    assert cum == sorted(cum) and cum[-1] == 4
+
+
+def test_state_snapshot_matches_registry_snapshot():
+    reg = MetricsRegistry()
+    reg.inc("a.count", 2)
+    reg.observe_ms("a.lat", 5.0)
+    reg.set_gauge("a.depth", 3)
+    flat = state_snapshot(reg.export_state())
+    snap = reg.snapshot()
+    for key in ("a.count", "a.depth", "a.lat.count", "a.lat.sum",
+                "a.lat.p50"):
+        assert flat[key] == snap[key]
+
+
+# --------------------------------------------------------- serving e2e
+def test_serving_request_id_header_and_trace_spans(tracer):
+    server, query = _echo_serving()
+    try:
+        url = server.address
+        resp, body = _post(url, {"x": 1})
+        rid = resp.headers["X-Request-Id"]
+        assert body == {"echo": 1} and rid
+
+        # client-supplied trace context joins the incoming trace
+        resp2, _ = _post(url, {"x": 2},
+                         headers={"X-Trace-Id": "cafe01:root9:1"})
+        rid2 = resp2.headers["X-Request-Id"]
+        time.sleep(0.05)
+
+        ingress = tracer.finished("serving.request")
+        # fresh trace: request id IS the trace id AND the root span id
+        mine = [s for s in ingress if s["span_id"] == rid]
+        assert mine and mine[0]["trace_id"] == rid
+        assert mine[0]["parent_id"] is None
+        assert mine[0]["attrs"]["status"] == 200
+        # joined trace: client's trace id, client's span as parent
+        joined = [s for s in ingress if s["span_id"] == rid2]
+        assert joined and joined[0]["trace_id"] == "cafe01"
+        assert joined[0]["parent_id"] == "root9"
+
+        # the partition-worker transform span carries the same ids
+        xf = tracer.finished("serving.partition.transform")
+        assert any(s["trace_id"] == rid and s["parent_id"] == rid
+                   for s in xf)
+        assert any(s["trace_id"] == "cafe01" and s["parent_id"] == rid2
+                   for s in xf)
+    finally:
+        query.stop()
+        server.stop()
+
+
+def test_serving_metrics_endpoint_selector_transport(tracer):
+    reliability_metrics.reset("serving.")
+    server, query = _echo_serving()
+    try:
+        url = server.address
+        for i in range(3):
+            _post(url, {"x": i})
+        text = urllib.request.urlopen(url + "/metrics",
+                                      timeout=15).read().decode()
+        assert "serving_request_e2e_seconds_bucket" in text
+        assert "serving.request.e2e" in text
+        state = json.loads(urllib.request.urlopen(
+            url + "/metrics.json", timeout=15).read())
+        assert state["hists"]["serving.request.e2e"]["count"] >= 3
+        # exposition is answered at ingress, never enqueued: no worker
+        # transform span may exist for it
+        assert not any(s["attrs"].get("path", "").startswith("/metrics")
+                       for s in tracer.finished(
+                           "serving.partition.transform"))
+    finally:
+        query.stop()
+        server.stop()
+
+
+def test_serving_metrics_endpoint_threading_transport():
+    reliability_metrics.reset("serving.")
+    server, query = _echo_serving(transport="threading")
+    try:
+        url = server.address
+        resp, _ = _post(url, {"x": 5})
+        assert resp.headers["X-Request-Id"]     # both transports carry it
+        text = urllib.request.urlopen(url + "/metrics",
+                                      timeout=15).read().decode()
+        assert "serving_request_e2e_seconds_bucket" in text
+    finally:
+        query.stop()
+        server.stop()
+
+
+def test_compiled_plan_span_joins_request_trace(tracer):
+    """The fast-path (io/plan.py) run lands as a child span inside the
+    request trace: ingress -> transform -> plan.run, one trace id."""
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+    from mmlspark_tpu.models.linear import LinearRegression
+    from mmlspark_tpu.core import Table
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x @ np.ones(4)).astype(np.float32)
+    model = LinearRegression().fit(Table({"features": x, "label": y}))
+    transform = compile_serving_transform(model, ["features"], "prediction")
+    server = ServingServer(num_partitions=1).start()
+    query = ServingQuery(server, transform, mode="continuous").start()
+    try:
+        resp, _ = _post(server.address, {"features": [0.1, 0.2, 0.3, 0.4]})
+        rid = resp.headers["X-Request-Id"]
+        time.sleep(0.05)
+        plan = tracer.finished("serving.plan.run")
+        assert any(s["trace_id"] == rid for s in plan), plan
+    finally:
+        query.stop()
+        server.stop()
+
+
+def test_registry_client_propagates_trace_context(tracer):
+    """RegistryClient posts carry X-Trace-Id: the serving ingress span on
+    the far side joins the caller's trace (the cross-service hop)."""
+    from mmlspark_tpu.io import (RegistryClient, ServiceRegistry,
+                                 report_server_to_registry)
+    reg = ServiceRegistry().start()
+    server, query = _echo_serving()
+    try:
+        host, port = server._httpd.server_address[:2]
+        report_server_to_registry(reg.address, "traced", host, port)
+        client = RegistryClient(reg.address, "traced")
+        with tracer.span("client.op") as sp:
+            status, _ = client.post(json.dumps({"x": 7}).encode())
+        assert status == 200
+        time.sleep(0.05)
+        ingress = tracer.finished("serving.request")
+        assert any(s["trace_id"] == sp.trace_id
+                   and s["parent_id"] == sp.span_id for s in ingress)
+    finally:
+        query.stop()
+        server.stop()
+        reg.stop()
+
+
+def test_scrape_cluster_merges_worker_snapshots(tracer):
+    """scrape_cluster pulls /metrics.json from every registered worker and
+    merges exactly; two workers exposing this process's registry merge to
+    2x its counts (and the registry's own /metrics renders too)."""
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    reliability_metrics.reset("serving.")
+    reg = ServiceRegistry().start()
+    s1, q1 = _echo_serving()
+    s2, q2 = _echo_serving()
+    try:
+        for name, s in (("scrape_a", s1), ("scrape_b", s2)):
+            host, port = s._httpd.server_address[:2]
+            report_server_to_registry(reg.address, name, host, port)
+        for i in range(4):
+            _post(s1.address, {"x": i})
+        _post(s2.address, {"x": 99})
+        # e2e is observed AFTER the reply routes: wait for the last
+        # worker-side observation to land before snapshotting
+        hist = reliability_metrics.histogram("serving.request.e2e")
+        deadline = time.monotonic() + 5.0
+        while hist.count < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        single = hist.count
+        assert single == 5
+        snap = scrape_cluster(reg.address)
+        assert snap.merged["telemetry.scrape.workers"] == 2
+        assert len(snap.workers) == 2
+        assert snap.merged["serving.request.e2e.count"] == 2 * single
+        one = scrape_cluster(reg.address, name="scrape_a")
+        assert one.merged["telemetry.scrape.workers"] == 1
+        assert one.merged["serving.request.e2e.count"] == single
+        text = urllib.request.urlopen(reg.address + "/metrics",
+                                      timeout=15).read().decode()
+        assert "serving_request_e2e_seconds_count" in text
+    finally:
+        q1.stop()
+        q2.stop()
+        s1.stop()
+        s2.stop()
+        reg.stop()
+
+
+# ------------------------------------------------- subprocess propagation
+_WORKER_SCRIPT = """
+import json, os, sys
+from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+from mmlspark_tpu import telemetry
+
+server = ServingServer(num_partitions=1).start()
+
+def transform(bodies):
+    return [{"y": json.loads(b)["x"] * 2} for b in bodies]
+
+query = ServingQuery(server, transform, mode="continuous").start()
+host, port = server._httpd.server_address[:2]
+print(f"ADDR {host} {port}", flush=True)
+sys.stdin.readline()            # parent signals: traffic done
+query.stop()
+server.stop()
+n = telemetry.get_tracer().export_jsonl(sys.argv[1])
+print(f"EXPORTED {n}", flush=True)
+"""
+
+
+def test_trace_context_propagates_to_subprocess_worker(tmp_path):
+    """Satellite: one trace id crosses a REAL process boundary — the parent
+    posts with X-Trace-Id, the subprocess serving worker's JSONL export
+    shows the ingress AND transform spans under that id, and the returned
+    X-Request-Id ties the headerless request to its exported trace."""
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_SCRIPT)
+    jsonl = str(tmp_path / "spans.jsonl")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MMLSPARK_TPU_TRACE_SAMPLE"] = "1"
+    env.pop("MMLSPARK_TPU_FAULTS", None)
+    proc = subprocess.Popen(
+        [sys.executable, str(script), jsonl], env=env, text=True,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("ADDR "), line
+        _, host, port = line.split()
+        url = f"http://{host}:{port}"
+        resp, body = _post(url, {"x": 21},
+                           headers={"X-Trace-Id": "xproc42:rootspan:1"})
+        assert body == {"y": 42}
+        resp2, _ = _post(url, {"x": 1})
+        bare_rid = resp2.headers["X-Request-Id"]
+        out, _ = proc.communicate(input="\n", timeout=60)
+        assert proc.returncode == 0, out
+        assert "EXPORTED" in out, out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    spans = telemetry.read_jsonl(jsonl)
+    ingress = [s for s in spans if s["name"] == "serving.request"]
+    xform = [s for s in spans if s["name"] == "serving.partition.transform"]
+    # the propagated trace id appears in both hops of the subprocess
+    assert any(s["trace_id"] == "xproc42" and s["parent_id"] == "rootspan"
+               for s in ingress)
+    assert any(s["trace_id"] == "xproc42" for s in xform)
+    # the headerless request's X-Request-Id IS its exported trace id
+    assert any(s["trace_id"] == bare_rid and s["span_id"] == bare_rid
+               for s in ingress)
+    assert any(s["trace_id"] == bare_rid for s in xform)
+
+
+# --------------------------------------------- supervisor / fault events
+def test_supervisor_and_fault_injector_event_log(tracer, tmp_path):
+    """A chaos run produces a causally-ordered structured event log: the
+    injected fault's event precedes the restart it provoked; checkpoint
+    writes and train steps appear as spans."""
+    from mmlspark_tpu.reliability import FaultInjector, TrainingSupervisor
+
+    state = {"w": np.zeros(4, np.float64)}
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "train.step2", "kind": "error", "at": [0]}])
+    sup = TrainingSupervisor(
+        str(tmp_path / "ckpt"),
+        snapshot_fn=lambda: {"w": state["w"].copy()},
+        restore_fn=lambda p: state.update(w=np.asarray(p["w"])),
+        checkpoint_every=2, handle_signals=False, faults=inj)
+
+    def step(k):
+        state["w"] = state["w"] + 1.0
+        return float(state["w"][0])
+
+    results = sup.run(step, 4)
+    sup.close()
+    assert results == [1.0, 2.0, 3.0, 4.0]    # restart healed the fault
+
+    events = [s for s in tracer.finished() if s["kind"] == "event"]
+    fault_ev = [s for s in events if s["name"] == "fault.injected"]
+    restart_ev = [s for s in events if s["name"] == "train.restart"]
+    assert fault_ev and fault_ev[0]["attrs"]["site"] == "train.step2"
+    assert restart_ev and restart_ev[0]["attrs"]["error"] == "InjectedFault"
+    # causal order: the injection precedes the restart it caused
+    assert fault_ev[0]["seq"] < restart_ev[0]["seq"]
+    steps = tracer.finished("train.step")
+    assert len(steps) >= 4
+    assert any(s["attrs"].get("error") == "InjectedFault" for s in steps)
+    writes = tracer.finished("checkpoint.write")
+    assert writes and all("step" in s["attrs"] for s in writes)
+
+
+def test_timer_stage_telemetry_sink(tracer, capsys):
+    """Satellite: Timer timings become spans instead of prints."""
+    from mmlspark_tpu.core import Table, Transformer
+    from mmlspark_tpu.stages.timer import TimerModel
+
+    class _Noop(Transformer):
+        def _transform(self, t):
+            return t
+
+    model = TimerModel(transformer=_Noop(), telemetry=True)
+    out = model.transform(Table({"a": np.arange(4)}))
+    assert list(out["a"]) == [0, 1, 2, 3]
+    assert capsys.readouterr().out == ""       # print suppressed
+    rec = tracer.finished("stage._Noop.transform")
+    assert len(rec) == 1 and rec[0]["duration_ms"] >= 0.0
+
+
+def test_timer_telemetry_falls_back_to_print_when_unsampled(capsys):
+    """Timer(telemetry=True) with sampling OFF must not silently drop the
+    timing: no span can record, so the console line comes back."""
+    from mmlspark_tpu.core import Table, Transformer
+    from mmlspark_tpu.stages.timer import TimerModel
+
+    class _Noop(Transformer):
+        def _transform(self, t):
+            return t
+
+    tr = telemetry.get_tracer()
+    tr.configure(sample=0.0)
+    tr.clear()
+    TimerModel(transformer=_Noop(), telemetry=True).transform(
+        Table({"a": np.arange(2)}))
+    assert "_Noop took" in capsys.readouterr().out
+    assert tr.stats()["spans"] == 0
+
+
+def test_gbdt_fit_span_records_failure(tracer):
+    """A fit that DIES still lands its gbdt.fit span (with the error) in
+    the ring — the chaos runs the span exists to explain."""
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+
+    def boom(*a, **k):
+        raise RuntimeError("tree grower exploded")
+
+    with pytest.raises(RuntimeError, match="exploded"):
+        fit_booster(x, y, BoostParams(num_iterations=2, max_depth=3),
+                    tree_fn=boom)
+    fits = tracer.finished("gbdt.fit")
+    assert fits and fits[-1]["attrs"]["error"] == "RuntimeError"
+
+
+def test_report_server_urllib_propagates_trace(tracer):
+    """report_server_to_registry posts via urllib (capitalized headers):
+    the registry must still join the caller's trace and log the event."""
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    reg = ServiceRegistry().start()
+    try:
+        with tracer.span("worker.boot") as sp:
+            report_server_to_registry(reg.address, "urllib_svc",
+                                      "127.0.0.1", 7200)
+        events = tracer.finished("registry.register")
+        assert any(e["trace_id"] == sp.trace_id for e in events)
+    finally:
+        reg.stop()
+
+
+def test_prefetcher_lifecycle_span(tracer):
+    from mmlspark_tpu.data import DevicePrefetcher
+    with DevicePrefetcher(range(5), depth=2, put=lambda v: v + 1) as pf:
+        got = list(pf)
+    assert got == [1, 2, 3, 4, 5]
+    rec = tracer.finished("data.prefetch")
+    assert len(rec) == 1
+    assert rec[0]["attrs"]["items"] == 5
+    assert rec[0]["attrs"]["depth"] == 2
+
+
+def test_wall_clock_tracer_falls_back_to_print_when_unsampled(capsys):
+    from mmlspark_tpu.utils import tracing
+    tr = telemetry.get_tracer()
+    tr.configure(sample=0.0)
+    tr.clear()
+    with tracing.wall_clock("unsampled.block", tracer=True):
+        pass
+    assert "unsampled.block:" in capsys.readouterr().out
+    assert tr.stats()["spans"] == 0
+
+
+def test_zero_sampling_still_joins_incoming_trace(tracer):
+    """Sampling 0% must not DROP a trace a client already started — the
+    fast-path membership test lets the three real header spellings
+    through to extract()."""
+    tracer.configure(sample=0.0)
+    server, query = _echo_serving()
+    try:
+        resp, _ = _post(server.address, {"x": 1},
+                        headers={"X-Trace-Id": "joined0:c1:1"})
+        rid = resp.headers["X-Request-Id"]
+        time.sleep(0.05)
+        ingress = tracer.finished("serving.request")
+        assert any(s["trace_id"] == "joined0" and s["span_id"] == rid
+                   for s in ingress)
+    finally:
+        query.stop()
+        server.stop()
+
+
+def test_threading_timeout_504_carries_request_id():
+    """A timed-out exchange still returns the correlation id — the slow
+    request is exactly the one worth quoting against traces."""
+    from mmlspark_tpu.io.serving import ServingServer
+    # no query started: every request rides reply_timeout into a 504
+    server = ServingServer(transport="threading", reply_timeout=0.2).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.address, {"x": 1})
+        assert ei.value.code == 504
+        assert ei.value.headers["X-Request-Id"]
+    finally:
+        server.stop(drain=False)
+
+
+def test_zero_sampling_keeps_request_ids_but_records_nothing():
+    """The acceptance fast path: sampling 0% still returns X-Request-Id
+    (ids are free — they exist for routing) but mints no spans."""
+    tr = telemetry.get_tracer()
+    tr.configure(sample=0.0)
+    tr.clear()
+    server, query = _echo_serving()
+    try:
+        resp, _ = _post(server.address, {"x": 3})
+        assert resp.headers["X-Request-Id"]
+        time.sleep(0.05)
+        assert tr.stats()["spans"] == 0
+    finally:
+        query.stop()
+        server.stop()
