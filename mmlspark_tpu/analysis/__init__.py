@@ -1,0 +1,63 @@
+"""graftlint: the project-invariant static analyzer.
+
+An AST-based checker for the contracts this framework carries but nothing
+enforced mechanically until now: lock discipline across the serving/ingest
+concurrency (no blocking I/O under a lock, no acquisition-order cycles),
+trace purity at every `jax.jit`/`pjit`/`shard_map` site (no Python
+branches on traced values, no `np.*` on tracers, no mutable closure
+capture), the bit-identical-resume determinism rules (monotonic clocks,
+seeded RNG, no set-order-dependent payloads), one canonical name per
+metric/span/fault-site (`telemetry/names.py`, kept in sync with
+`docs/observability.md`), fault-site sync between chaos tests and code,
+resource hygiene (joined threads, unlinked shared memory), and
+pytest-marker declaration.
+
+Use it as a library::
+
+    from mmlspark_tpu.analysis import run
+    report = run(["mmlspark_tpu", "tests"], root=repo_root)
+    assert not report.active, report.render_text()
+
+or as a CLI (also installed as the `graftlint` console script)::
+
+    python -m mmlspark_tpu.analysis --strict mmlspark_tpu tests
+
+Workflow: new violations fail `--strict`; a finding that is correct as
+written gets a `# graftlint: disable=<rule>` comment on its line;
+inherited debt lives in the committed `graftlint.baseline.json`
+(regenerate with `--write-baseline`). docs/analysis.md has the rule
+catalog with bad/good examples.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+from .checkers import default_rules
+from .core import (Analyzer, Baseline, Finding, Module, Project, Report,
+                   Rule)
+
+BASELINE_FILENAME = "graftlint.baseline.json"
+
+
+def run(paths: Iterable[str], root: Optional[str] = None,
+        baseline_path: Optional[str] = None,
+        rules: Optional[Iterable[Rule]] = None) -> Report:
+    """Analyze `paths` (files/dirs, relative to `root`) with the default
+    rule set. `baseline_path=None` auto-loads `graftlint.baseline.json`
+    from `root` when present; pass "" to disable the baseline."""
+    analyzer = Analyzer(rules if rules is not None else default_rules(),
+                        root=root)
+    if baseline_path is None:
+        candidate = os.path.join(analyzer.root, BASELINE_FILENAME)
+        baseline_path = candidate if os.path.exists(candidate) else ""
+    elif baseline_path and not os.path.isabs(baseline_path):
+        # relative baselines resolve against root, like the analyzed paths
+        # (and like where --write-baseline puts the file) — never the cwd
+        baseline_path = os.path.join(analyzer.root, baseline_path)
+    baseline = Baseline.load(baseline_path) if baseline_path else None
+    return analyzer.run(paths, baseline=baseline)
+
+
+__all__ = ["Analyzer", "Baseline", "Finding", "Module", "Project",
+           "Report", "Rule", "default_rules", "run", "BASELINE_FILENAME"]

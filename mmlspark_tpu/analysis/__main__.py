@@ -1,0 +1,10 @@
+"""`python -m mmlspark_tpu.analysis` — the graftlint CLI.
+
+The __name__ guard matters: package-walking tooling (codegen API docs,
+the fuzz-meta inventory) imports every submodule, and an unguarded
+SystemExit would run the CLI against pytest's argv.
+"""
+from .cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,35 @@
+"""The project-invariant rule set graftlint ships with."""
+from .determinism import LegacyRandomRule, SetIterationRule, WallClockRule
+from .faultsync import FaultSiteUnknownRule, FaultSiteUntestedRule
+from .hygiene import ShmNoUnlinkRule, ThreadNotJoinedRule
+from .locks import LockBlockingCallRule, LockOrderCycleRule
+from .markers import PytestMarkerRule
+from .names import (MetricKindCollisionRule, MetricNameRule,
+                    MetricNameUndocumentedRule)
+from .tracing import (TraceMutableClosureRule, TraceNumpyCallRule,
+                      TracePythonBranchRule)
+
+
+def default_rules():
+    """One instance of every shipped rule, in reporting order."""
+    return [
+        LockBlockingCallRule(),
+        LockOrderCycleRule(),
+        TracePythonBranchRule(),
+        TraceNumpyCallRule(),
+        TraceMutableClosureRule(),
+        WallClockRule(),
+        LegacyRandomRule(),
+        SetIterationRule(),
+        MetricNameRule(),
+        MetricKindCollisionRule(),
+        MetricNameUndocumentedRule(),
+        FaultSiteUnknownRule(),
+        FaultSiteUntestedRule(),
+        ThreadNotJoinedRule(),
+        ShmNoUnlinkRule(),
+        PytestMarkerRule(),
+    ]
+
+
+__all__ = ["default_rules"]

@@ -1,0 +1,147 @@
+"""Determinism: the bit-identical crash-resume contract, mechanically.
+
+PRs 1/4 made kill-resume training BIT-identical; what protects that is a
+set of habits nothing enforced: no wall-clock (`time.time()` jumps with
+NTP steps — interval math and freshness checks need the monotonic clock;
+epoch-valued timestamps come from `telemetry.spans.wall_now()`, one
+monotonic-derived anchor per process), no process-seeded RNG (`random.*`
+module functions and the legacy `np.random.*` API draw from ambient
+global state a resume cannot replay — seeded `random.Random(seed)` /
+`np.random.default_rng(seed)` / `jax.random` keys are the replayable
+forms), and no iteration over `set`s when building ordered payloads
+(iteration order varies per process with PYTHONHASHSEED).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Module, Rule, dotted_name
+
+# legacy global-state np.random functions (Generator methods are fine)
+_NP_RANDOM_LEGACY = {
+    "seed", "random", "rand", "randn", "randint", "random_sample",
+    "ranf", "sample", "choice", "shuffle", "permutation", "uniform",
+    "normal", "standard_normal", "beta", "binomial", "poisson",
+    "exponential", "gamma", "get_state", "set_state",
+}
+# random-module functions drawing from the hidden global Random()
+_RANDOM_MODULE_FNS = {
+    "seed", "random", "randint", "randrange", "uniform", "choice",
+    "choices", "shuffle", "sample", "gauss", "normalvariate",
+    "betavariate", "expovariate", "getrandbits", "triangular",
+}
+
+
+class WallClockRule(Rule):
+    name = "wall-clock"
+    severity = "error"
+    description = ("time.time() — wall clock jumps with NTP; use "
+                   "time.monotonic()/perf_counter() for intervals, "
+                   "telemetry.spans.wall_now() for epoch timestamps")
+
+    def check(self, module: Module) -> Iterable:
+        if module.is_test:
+            return
+        # `from time import time [as now]` and `import time as t` bind the
+        # same wall clock under other names — resolve them or the gate is
+        # one import-style away from useless
+        bare = set()
+        mods = {"time"}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        bare.add(a.asname or a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time" and a.asname:
+                        mods.add(a.asname)
+        dotted = {f"{m}.time" for m in mods}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in dotted or (isinstance(node.func, ast.Name)
+                                  and node.func.id in bare):
+                yield module.finding(
+                    self, node,
+                    "time.time() — use time.monotonic()/perf_counter() "
+                    "for intervals or telemetry.spans.wall_now() for "
+                    "monotonic epoch timestamps")
+
+
+class LegacyRandomRule(Rule):
+    name = "legacy-random"
+    severity = "error"
+    description = ("Global-state RNG (bare random.* / legacy np.random.*) "
+                   "— a resumed run cannot replay ambient RNG state; use "
+                   "random.Random(seed) / np.random.default_rng(seed)")
+
+    def check(self, module: Module) -> Iterable:
+        if module.is_test:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if (len(parts) == 3 and parts[0] in ("np", "numpy")
+                    and parts[1] == "random"
+                    and parts[2] in _NP_RANDOM_LEGACY):
+                yield module.finding(
+                    self, node,
+                    f"legacy `{name}()` draws from the global numpy "
+                    f"RNG — use np.random.default_rng(seed)")
+            elif (len(parts) == 2 and parts[0] == "random"
+                    and parts[1] in _RANDOM_MODULE_FNS):
+                yield module.finding(
+                    self, node,
+                    f"`{name}()` draws from the hidden module-global "
+                    f"Random() — use random.Random(seed)")
+
+
+class SetIterationRule(Rule):
+    name = "set-iteration"
+    severity = "error"
+    description = ("Iteration over a set builds order-dependent output — "
+                   "set order varies with PYTHONHASHSEED across processes; "
+                   "wrap in sorted()")
+
+    def check(self, module: Module) -> Iterable:
+        if module.is_test:
+            return
+        for node in ast.walk(module.tree):
+            iter_expr = None
+            if isinstance(node, ast.For):
+                iter_expr = node.iter
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iter_expr = node.generators[0].iter
+            if iter_expr is None:
+                continue
+            if not self._is_set_expr(iter_expr):
+                continue
+            yield module.finding(
+                self, iter_expr,
+                "iterating a set — order varies per process "
+                "(PYTHONHASHSEED); wrap in sorted() if the output order "
+                "matters")
+
+    @staticmethod
+    def _is_set_expr(expr) -> bool:
+        # direct `set(...)` / `frozenset(...)` call or a set literal /
+        # set-union BinOp of those; sorted(...) never reaches here because
+        # the iter expr would be the sorted() call
+        if isinstance(expr, ast.Call):
+            leaf = (dotted_name(expr.func) or "").split(".")[-1]
+            return leaf in ("set", "frozenset", "intersection", "union",
+                            "difference", "symmetric_difference")
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.BitOr):
+            return (SetIterationRule._is_set_expr(expr.left)
+                    or SetIterationRule._is_set_expr(expr.right))
+        return False

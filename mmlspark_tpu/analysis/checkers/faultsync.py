@@ -1,0 +1,101 @@
+"""Fault-site sync: chaos tests and code must name the same injection
+sites.
+
+A `FaultInjector` site only exists where code calls `perturb(site)` /
+`fire(site)`. A chaos test targeting a site the code no longer fires
+passes VACUOUSLY — the rule that should fault never matches, nothing is
+injected, and the recovery path under test silently stops being tested.
+The reverse is quieter debt: a site the code fires that no test ever
+schedules a rule for is an untested recovery path.
+
+- `fault-site-unknown` (error): a site referenced by a test (rule dicts
+  `{"site": ...}`, `perturb`/`fire`/`corrupt_*` calls) that matches no
+  site fired in package code. Test refs may be globs (`serving.*`);
+  code sites may be f-string patterns (`data.worker.chunk{index}`).
+  Dot-less names ("w", "x") are unit-test synthetics and exempt.
+- `fault-site-untested` (warning): a code-fired site no test references.
+"""
+from __future__ import annotations
+
+import fnmatch
+from typing import Iterable, List
+
+from .. import harvest as hv
+from ..core import Finding, Project, Rule
+
+
+def _code_sites(project: Project) -> List[hv.Use]:
+    return [u for u in hv.project_uses(project, test_modules=False)
+            if u.kind == hv.FAULT]
+
+
+def _test_refs(project: Project) -> List[hv.Use]:
+    """Rule-schedule references ({"site": ...}) in tests — the entries
+    that silently stop matching when code renames a site."""
+    return [u for u in hv.project_uses(project, test_modules=True)
+            if u.kind == hv.FAULT_REF and "." in u.name]
+
+
+def _test_exercised(project: Project) -> List[hv.Use]:
+    """Everything tests touch: schedule refs plus direct fires
+    (perturb/corrupt_* called straight from a test). Dot-less names stay
+    in here — `corrupt_file`'s default "checkpoint" site is a real
+    exercise even though it never matches a dotted code site."""
+    return [u for u in hv.project_uses(project, test_modules=True)
+            if u.kind in (hv.FAULT, hv.FAULT_REF)]
+
+
+def _matches(ref: hv.Use, site: hv.Use) -> bool:
+    """Does a test reference reach a code site? Either side may be a
+    pattern: the ref a glob, the site an f-string skeleton."""
+    if site.is_pattern:
+        rx = hv.pattern_to_regex(site.name)
+        if rx.match(ref.name):
+            return True
+        # glob ref vs pattern site: compare the static prefixes
+        prefix = site.name.split("{", 1)[0]
+        return ref.name.endswith("*") and prefix.startswith(ref.name[:-1])
+    if ref.name == site.name:
+        return True
+    return fnmatch.fnmatchcase(site.name, ref.name)
+
+
+class FaultSiteUnknownRule(Rule):
+    name = "fault-site-unknown"
+    severity = "error"
+    description = ("Test references a FaultInjector site no package code "
+                   "fires (the chaos test passes vacuously)")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        sites = _code_sites(project)
+        for ref in _test_refs(project):
+            if any(_matches(ref, s) for s in sites):
+                continue
+            yield Finding(
+                self.name, ref.rel, ref.line, ref.col,
+                f"fault site {ref.name!r} is referenced by this test but "
+                f"never fired by package code — the injection never "
+                f"happens", self.severity)
+
+
+class FaultSiteUntestedRule(Rule):
+    name = "fault-site-untested"
+    severity = "warning"
+    description = ("Package code fires a FaultInjector site no test "
+                   "schedules a rule for (untested recovery path)")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        refs = _test_exercised(project)
+        seen = set()
+        for site in _code_sites(project):
+            key = site.name
+            if key in seen:
+                continue
+            seen.add(key)
+            if any(_matches(ref, site) for ref in refs):
+                continue
+            yield Finding(
+                self.name, site.rel, site.line, site.col,
+                f"fault site {site.name!r} is fired here but no test "
+                f"references it — its recovery path is untested",
+                self.severity)

@@ -1,0 +1,193 @@
+"""Resource hygiene: threads that outlive their owner, shared memory that
+outlives the process.
+
+- `thread-not-joined` (error): a `threading.Thread(...)` constructed
+  without `daemon=True` whose handle is never `.join()`ed in the same
+  file. A non-daemon thread silently blocks interpreter exit; the repo
+  convention is daemon threads + explicit join on the stop path.
+- `shm-no-unlink` (error): a `SharedMemory(create=True)` segment with no
+  `.unlink()` reachable in the creating function — leaked segments
+  survive the process in /dev/shm until reboot. The unlink should sit in
+  a `finally` so every exit path releases it; present-but-unprotected
+  unlink is reported as a warning variant of the same rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional, Set
+
+from ..core import Module, Rule, dotted_name, enclosing_function
+
+# receiver names that plausibly hold a thread/process handle
+_THREADISH = re.compile(r"(thread|proc|worker|^th?\d*$)", re.IGNORECASE)
+
+
+def _assign_target_name(node) -> Optional[str]:
+    """`x = ...` / `self.x = ...` target as a dotted string."""
+    parent = getattr(node, "_gl_parent", None)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        return dotted_name(parent.targets[0])
+    if isinstance(parent, ast.AnnAssign):
+        return dotted_name(parent.target)
+    return None
+
+
+class ThreadNotJoinedRule(Rule):
+    name = "thread-not-joined"
+    severity = "error"
+    description = ("Non-daemon threading.Thread never joined in this file "
+                   "— blocks interpreter exit")
+
+    def check(self, module: Module) -> Iterable:
+        if module.is_test:
+            return
+        ctors = self._thread_ctors(module)
+        joined, daemon_set = self._joins_and_daemon_sets(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name not in ctors:
+                continue
+            if any(kw.arg == "daemon"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in node.keywords):
+                continue
+            target = _assign_target_name(node)
+            leaf = target.split(".")[-1] if target else None
+            if leaf is not None and (leaf in joined or leaf in daemon_set):
+                continue
+            if leaf is None and self._scope_has_join(node):
+                # anonymous/comprehension-built threads: joining happens
+                # through a loop variable; any .join() in scope counts
+                continue
+            yield module.finding(
+                self, node,
+                "threading.Thread without daemon=True and never joined "
+                "in this file — pass daemon=True or join it on the stop "
+                "path")
+
+    @staticmethod
+    def _thread_ctors(module: Module) -> Set[str]:
+        """Names that construct a Thread in this module — resolves
+        `import threading as t` / `from threading import Thread as T`
+        aliases so the leak gate is not one import-style away from blind."""
+        ctors = {"threading.Thread", "Thread"}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading" and a.asname:
+                        ctors.add(f"{a.asname}.Thread")
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "threading"):
+                for a in node.names:
+                    if a.name == "Thread" and a.asname:
+                        ctors.add(a.asname)
+        return ctors
+
+    @staticmethod
+    def _scope_has_join(node) -> bool:
+        fn = enclosing_function(node)
+        if fn is None:
+            return False
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Attribute) and n.attr == "join"):
+                continue
+            recv = dotted_name(n.value)
+            # only thread-shaped receivers count — `",".join(parts)` must
+            # not silently disable the leak check for the whole function
+            if recv is not None and _THREADISH.search(recv.split(".")[-1]):
+                return True
+        return False
+
+    @staticmethod
+    def _joins_and_daemon_sets(module: Module):
+        joined: Set[str] = set()
+        daemon_set: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                recv = dotted_name(node.func.value)
+                if recv:
+                    joined.add(recv.split(".")[-1])
+            elif (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and node.targets[0].attr == "daemon"):
+                recv = dotted_name(node.targets[0].value)
+                if recv:
+                    daemon_set.add(recv.split(".")[-1])
+        return joined, daemon_set
+
+
+class ShmNoUnlinkRule(Rule):
+    name = "shm-no-unlink"
+    severity = "error"
+    description = ("SharedMemory(create=True) without unlink() on every "
+                   "exit path (leaks /dev/shm segments)")
+
+    def check(self, module: Module) -> Iterable:
+        if module.is_test:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name.split(".")[-1] != "SharedMemory":
+                continue
+            if not any(kw.arg == "create"
+                       and isinstance(kw.value, ast.Constant)
+                       and kw.value.value is True for kw in node.keywords):
+                continue
+            fn = enclosing_function(node)
+            scope = fn if fn is not None else module.tree
+            target = _assign_target_name(node)
+            leaf = target.split(".")[-1] if target else None
+            unlinked, in_finally = self._unlink_coverage(scope, leaf)
+            if not unlinked:
+                yield module.finding(
+                    self, node,
+                    f"SharedMemory(create=True){f' ({leaf})' if leaf else ''}"
+                    " is never unlink()ed in this function — the segment "
+                    "leaks in /dev/shm")
+            elif not in_finally:
+                yield module.finding(
+                    self, node,
+                    f"SharedMemory segment {leaf or ''} is unlinked, but "
+                    f"not from a finally block — an exception path leaks "
+                    f"it", severity="warning")
+
+    @staticmethod
+    def _unlink_coverage(scope, leaf: Optional[str]):
+        """(any unlink on this name?, is one inside a finally?). Names
+        reached through loop vars over tuples containing the name count:
+        `for shm in (shm_in, shm_out): shm.unlink()`."""
+        aliases: Set[str] = {leaf} if leaf else set()
+        for node in ast.walk(scope):
+            if isinstance(node, ast.For) and isinstance(node.target,
+                                                        ast.Name):
+                for elt in ast.walk(node.iter):
+                    nm = dotted_name(elt) if isinstance(
+                        elt, (ast.Name, ast.Attribute)) else None
+                    if nm and nm.split(".")[-1] in aliases:
+                        aliases.add(node.target.id)
+        unlinked = in_finally = False
+        finally_nodes = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    finally_nodes.extend(ast.walk(stmt))
+        finally_ids = {id(n) for n in finally_nodes}
+        for node in ast.walk(scope):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "unlink"):
+                recv = dotted_name(node.func.value)
+                recv_leaf = recv.split(".")[-1] if recv else None
+                if leaf is None or recv_leaf in aliases:
+                    unlinked = True
+                    if id(node) in finally_ids:
+                        in_finally = True
+        return unlinked, in_finally

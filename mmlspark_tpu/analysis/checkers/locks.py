@@ -1,0 +1,336 @@
+"""Lock discipline: no blocking work inside a critical section, no
+acquisition-order cycles.
+
+The repo's concurrency story (serving partition queues, plan cache,
+metrics registry, checkpoint writer, stream sources) leans on many small
+locks; the two failure modes that survive review are (1) a blocking call —
+file/socket I/O, a no-timeout queue op, `device_put`, subprocess — made
+while a `with <lock>:` is held, turning one slow caller into a convoy, and
+(2) two locks acquired in opposite orders on different paths, the classic
+deadlock. Both are lexically visible.
+
+`lock-blocking-call` flags the first; receivers named like the held lock
+are exempt (``cond.wait()`` inside ``with cond:`` *releases* the lock —
+that is the condition-variable protocol, not a convoy).
+
+`lock-order-cycle` builds a project-wide acquisition-order graph: an edge
+A -> B for every `with B:` nested (lexically, or through one level of
+same-class method calls) inside `with A:`, then reports any cycle.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Module, Project, Rule, dotted_name
+
+_LOCK_NAME = re.compile(r"(^|_)(lock|cond|condition|mutex|sem|semaphore)s?$",
+                        re.IGNORECASE)
+
+# receiver attribute names that block on the network / another thread
+_BLOCKING_ATTRS = {"recv", "recv_into", "accept", "connect", "sendall",
+                   "makefile", "getaddrinfo", "create_connection",
+                   "urlopen", "communicate", "block_until_ready",
+                   "device_put", "getresponse"}
+# dotted-call prefixes that block (I/O, processes, sleeping)
+_BLOCKING_DOTTED = {"time.sleep", "subprocess.run", "subprocess.call",
+                    "subprocess.check_call", "subprocess.check_output",
+                    "subprocess.Popen", "urllib.request.urlopen",
+                    "os.fsync", "os.replace", "shutil.copy",
+                    "shutil.copytree", "shutil.move", "jax.device_put",
+                    "socket.create_connection"}
+# bare builtins that block
+_BLOCKING_NAMES = {"open", "sleep", "urlopen", "device_put"}
+# queue-ish receiver: .get()/.put()/.join() with no timeout on these blocks
+_QUEUE_RECV = re.compile(r"(^|_)(q|queue|result_q|outq|inq)\d*$",
+                         re.IGNORECASE)
+_THREAD_RECV = re.compile(r"(thread|proc|worker)", re.IGNORECASE)
+
+
+def _is_lockish(expr) -> Optional[str]:
+    """Dotted name of a `with` context expr that looks like a lock."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    return name if _LOCK_NAME.search(last) else None
+
+
+def _queue_op_bounded(call: ast.Call) -> bool:
+    """Is this .get()/.put() bounded (can't block forever)? A `timeout=`
+    makes it bounded; `block=False` (kwarg or positional) makes it
+    non-blocking; a bare `block=True` is exactly the unbounded wait the
+    rule exists to flag."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if kw.arg == "block":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False)
+    if len(call.args) >= 2:      # get(block, timeout) positional form
+        return True
+    if len(call.args) == 1:      # get(False) is non-blocking
+        a = call.args[0]
+        return isinstance(a, ast.Constant) and a.value is False
+    return False
+
+
+def _blocking_reason(call: ast.Call, held: str) -> Optional[str]:
+    func = call.func
+    name = dotted_name(func)
+    if name is not None:
+        if name in _BLOCKING_DOTTED:
+            return f"call to {name}"
+        leaf = name.split(".")[-1]
+        if name in _BLOCKING_NAMES or (leaf in _BLOCKING_NAMES
+                                       and "." not in name):
+            return f"call to {name}"
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    recv = dotted_name(func.value)
+    recv_leaf = recv.split(".")[-1] if recv else ""
+    if recv == held:
+        # methods of the held lock itself are the locking protocol, not
+        # work done under the lock — notably Condition.wait, which
+        # RELEASES the held lock while blocked
+        return None
+    if attr in _BLOCKING_ATTRS:
+        # allow e.g. `self._sleep(...)`-style injected clocks? those are
+        # Name calls, not attributes named in _BLOCKING_ATTRS
+        return f".{attr}() (blocking I/O)"
+    if attr == "wait":
+        # held-lock receivers returned above; any other .wait() blocks
+        # while still holding the lock
+        return ".wait() on a different object while the lock is held"
+    if attr in ("get", "put", "join"):
+        if attr == "join" and recv and not _THREAD_RECV.search(recv_leaf):
+            return None
+        if attr in ("get", "put") and (recv is None
+                                       or not _QUEUE_RECV.search(recv_leaf)):
+            return None
+        if attr in ("get", "put") and _queue_op_bounded(call):
+            return None
+        return f".{attr}() with no timeout"
+    return None
+
+
+def _walk_stopping_at_defs(body):
+    """Nodes executed when `body` runs — stops at nested function
+    definitions (their bodies run later, in another context)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _iter_withs_with_class(tree):
+    """Yield (enclosing_class_name, With node) pairs for the module."""
+    def rec(node, cls):
+        for child in ast.iter_child_nodes(node):
+            child_cls = child.name if isinstance(child, ast.ClassDef) else cls
+            if isinstance(child, ast.With):
+                yield cls, child
+            yield from rec(child, child_cls)
+    yield from rec(tree, None)
+
+
+class LockBlockingCallRule(Rule):
+    name = "lock-blocking-call"
+    severity = "error"
+    description = ("Blocking call (file/socket I/O, no-timeout queue op, "
+                   "sleep, subprocess, device_put) while a lock is held")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.is_test:
+            return
+        method_blocking = self._method_blocking_map(module)
+        for cls, node in _iter_withs_with_class(module.tree):
+            for item in node.items:
+                held = _is_lockish(item.context_expr)
+                if held is None:
+                    continue
+                for inner in _walk_stopping_at_defs(node.body):
+                    if not isinstance(inner, ast.Call):
+                        continue
+                    reason = _blocking_reason(inner, held)
+                    if reason is None:
+                        # one level deep: `self.m()` under the lock, where
+                        # m's own body (SAME class — another class's
+                        # same-named method is a different m) blocks
+                        name = dotted_name(inner.func)
+                        if (name and name.startswith("self.")
+                                and "." not in name[5:]):
+                            via = method_blocking.get((cls, name[5:]))
+                            if via is not None:
+                                reason = (f"call to self.{name[5:]}() "
+                                          f"which performs {via}")
+                    if reason is not None:
+                        yield module.finding(
+                            self, inner,
+                            f"{reason} while holding `{held}` — narrow "
+                            f"the critical section")
+
+    @staticmethod
+    def _method_blocking_map(module: Module):
+        """(class, method) -> first blocking reason found directly in its
+        body (same-module; one level, no recursion). Stops at nested
+        defs: a method that only DEFINES a blocking closure does not
+        itself block."""
+        out = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for meth in node.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for inner in _walk_stopping_at_defs(meth.body):
+                    if isinstance(inner, ast.Call):
+                        reason = _blocking_reason(inner, held="")
+                        if reason is not None:
+                            out.setdefault((node.name, meth.name), reason)
+                            break
+        return out
+
+
+# ---------------------------------------------------------------- ordering
+def _lock_identity(module: Module, expr, cls: Optional[str]) -> str:
+    """Stable cross-module identity for a lock expression."""
+    name = dotted_name(expr) or "<dynamic>"
+    parts = name.split(".")
+    stem = module.rel.rsplit("/", 1)[-1].removesuffix(".py")
+    if parts[0] == "self" and cls:
+        return f"{stem}.{cls}.{'.'.join(parts[1:])}"
+    if len(parts) == 1:
+        return f"{stem}.{parts[0]}"
+    return name   # foreign attribute chain: approximate identity
+
+
+class _LockGraphVisitor(ast.NodeVisitor):
+    """Collect, per function: lock with-statements, nested ordering edges,
+    and calls made while holding a lock (for one-level call resolution)."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.cls: Optional[str] = None
+        self.fn: Optional[str] = None
+        self.held: List[str] = []
+        # method key -> locks acquired directly
+        self.acquires: Dict[str, Set[str]] = {}
+        # direct ordering edges: (outer, inner) -> location
+        self.edges: Dict[Tuple[str, str], tuple] = {}
+        # calls under a lock: (held_lock, method_name, self_call) -> loc
+        self.calls_under: List[tuple] = []
+
+    def visit_ClassDef(self, node):
+        prev, self.cls = self.cls, node.name
+        self.generic_visit(node)
+        self.cls = prev
+
+    def _visit_fn(self, node):
+        prev_fn, self.fn = self.fn, f"{self.cls or ''}.{node.name}"
+        prev_held, self.held = self.held, []
+        self.generic_visit(node)
+        self.fn, self.held = prev_fn, prev_held
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_With(self, node):
+        n_added = 0
+        for item in node.items:
+            if _is_lockish(item.context_expr) is None:
+                continue
+            lk = _lock_identity(self.module, item.context_expr, self.cls)
+            if self.fn is not None:
+                self.acquires.setdefault(self.fn, set()).add(lk)
+            for outer in self.held:
+                if outer != lk:
+                    self.edges.setdefault(
+                        (outer, lk),
+                        (self.module.rel, node.lineno, node.col_offset))
+            # append BEFORE the next item: `with a, b:` acquires left to
+            # right, so b's ordering edge must see a as already held
+            self.held.append(lk)
+            n_added += 1
+        self.generic_visit(node)
+        del self.held[len(self.held) - n_added:]
+
+    def visit_Call(self, node):
+        if self.held:
+            name = dotted_name(node.func)
+            if name is not None:
+                parts = name.split(".")
+                self_call = parts[0] == "self" and len(parts) == 2
+                for held in self.held:
+                    self.calls_under.append(
+                        (held, parts[-1], self_call, self.cls,
+                         (self.module.rel, node.lineno, node.col_offset)))
+        self.generic_visit(node)
+
+
+class LockOrderCycleRule(Rule):
+    name = "lock-order-cycle"
+    severity = "error"
+    description = ("Two locks acquired in opposite orders on different "
+                   "paths (acquisition-order graph cycle)")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        edges: Dict[Tuple[str, str], tuple] = {}
+        visitors = []
+        for m in project.package_modules():
+            if m.tree is None:
+                continue
+            v = _LockGraphVisitor(m)
+            v.visit(m.tree)
+            visitors.append(v)
+            edges.update(v.edges)
+        # one-level call resolution: `self.m()` under lock A adds
+        # A -> (locks m acquires); cross-class only when the method name
+        # is globally unique among lock-acquiring methods
+        by_method: Dict[str, List[Tuple[str, Set[str]]]] = {}
+        for v in visitors:
+            for fn_key, locks in v.acquires.items():
+                cls, _, meth = fn_key.rpartition(".")
+                by_method.setdefault(meth, []).append((cls, locks))
+        for v in visitors:
+            for held, meth, self_call, cls, loc in v.calls_under:
+                cands = by_method.get(meth, [])
+                if self_call:
+                    cands = [c for c in cands if c[0] == cls]
+                if len(cands) != 1:
+                    continue
+                for lk in cands[0][1]:
+                    if lk != held:
+                        edges.setdefault((held, lk), loc)
+        # cycle detection (DFS over the digraph)
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start:
+                        cyc = frozenset(path)
+                        if cyc in reported:
+                            continue
+                        reported.add(cyc)
+                        loc = edges.get((node, start)) or edges.get(
+                            (path[0], path[1] if len(path) > 1 else start))
+                        rel, line, col = loc if loc else ("", 0, 0)
+                        order = " -> ".join(path + [start])
+                        yield Finding(
+                            self.name, rel, line, col,
+                            f"lock acquisition-order cycle: {order}",
+                            self.severity)
+                    elif nxt not in path and len(path) < 6:
+                        stack.append((nxt, path + [nxt]))

@@ -1,0 +1,71 @@
+"""Pytest-marker lint: every `@pytest.mark.<name>` must be declared.
+
+The tier-1 gate is `pytest -m 'not slow'`; a marker that is used in
+tests/ but not declared under `[tool.pytest.ini_options] markers` in
+pyproject.toml is exactly how a `slow` or `chaos` test silently stops
+being filtered (pytest only warns, and CI logs swallow warnings).
+Built-in marks (`parametrize`, `skipif`, ...) are exempt.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional, Set
+
+from ..core import Finding, Project, Rule, dotted_name
+
+_BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+                  "filterwarnings", "timeout", "flaky"}
+
+_MARKERS_BLOCK = re.compile(
+    r"^\s*markers\s*=\s*\[(?P<body>.*?)\]", re.DOTALL | re.MULTILINE)
+_STRING = re.compile(r"\"([^\"]+)\"|'([^']+)'")
+
+
+def declared_markers(pyproject_text: Optional[str]) -> Set[str]:
+    """Marker names from `[tool.pytest.ini_options] markers`. tomllib
+    when available (3.11+); a regex fallback keeps 3.10 working."""
+    if not pyproject_text:
+        return set()
+    try:
+        import tomllib
+        data = tomllib.loads(pyproject_text)
+        entries = (data.get("tool", {}).get("pytest", {})
+                   .get("ini_options", {}).get("markers", []))
+    except Exception:  # noqa: BLE001 - no tomllib / malformed: regex
+        m = _MARKERS_BLOCK.search(pyproject_text)
+        if not m:
+            return set()
+        entries = [a or b for a, b in _STRING.findall(m.group("body"))]
+    return {e.split(":", 1)[0].strip() for e in entries if e.strip()}
+
+
+class PytestMarkerRule(Rule):
+    name = "pytest-marker-undeclared"
+    severity = "error"
+    description = ("@pytest.mark.<name> used in tests/ but not declared "
+                   "in pyproject.toml markers — the mark filter silently "
+                   "misses it")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        declared = declared_markers(project.read_file("pyproject.toml"))
+        for m in project.test_modules():
+            if m.tree is None:
+                continue
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                name = dotted_name(node)
+                if name is None or not name.startswith("pytest.mark."):
+                    continue
+                parts = name.split(".")
+                if len(parts) != 3:
+                    continue
+                mark = parts[2]
+                if mark in _BUILTIN_MARKS or mark in declared:
+                    continue
+                yield Finding(
+                    self.name, m.rel, node.lineno, node.col_offset,
+                    f"marker {mark!r} is not declared in pyproject.toml "
+                    f"[tool.pytest.ini_options] markers — `-m` filters "
+                    f"silently skip it", self.severity)

@@ -1,0 +1,294 @@
+"""Metric / span / fault-site name registry checks.
+
+String-keyed observability rots in a specific way: a typo'd counter name
+silently splits one signal into two, a name used as both counter and
+gauge silently overwrites itself in `snapshot()`, and the docs table
+drifts from the code. `telemetry/names.py` is the canonical registry
+(constants + kind-keyed dicts with one-line descriptions); these rules
+hold every call site and the docs to it:
+
+- `metric-name-unknown`: a literal handed to `inc`/`observe_ms`/
+  `set_gauge`/`tracer.span`/`perturb`/... that is not canonical for that
+  kind (and has no near-miss — see typo rule). Applies to tests too: a
+  test asserting on a misspelled counter silently asserts on 0 forever.
+- `metric-name-typo`: an unknown literal within edit distance 2 of a
+  canonical name — the typo case, reported with the intended name.
+- `metric-kind-collision`: one name used as two colliding metric kinds
+  (counter/gauge/histogram/timing share a snapshot namespace — a gauge
+  named like a counter overwrites it in `snapshot()`).
+- `metric-name-undocumented`: a canonical name missing from the
+  `docs/observability.md` name table.
+"""
+from __future__ import annotations
+
+import difflib
+import importlib.util
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from .. import harvest as hv
+from ..core import Finding, Project, Rule
+
+_NAMES_REL = "telemetry/names.py"
+# registry attr per harvested kind; span/event share a namespace (a
+# tracer.record may legitimately carry either)
+_KIND_ATTRS = {
+    hv.COUNTER: ("COUNTERS",),
+    hv.GAUGE: ("GAUGES",),
+    hv.HISTOGRAM: ("HISTOGRAMS",),
+    hv.TIMING: ("TIMINGS",),
+    hv.SPAN: ("SPANS", "EVENTS"),
+    hv.EVENT: ("EVENTS", "SPANS"),
+    hv.FAULT: ("FAULT_SITES",),
+    hv.FAULT_REF: ("FAULT_SITES",),
+}
+_METRIC_FAMILY = ("COUNTERS", "GAUGES", "HISTOGRAMS", "TIMINGS")
+# snapshot()-derived keys tests legitimately read back
+_DERIVED_SUFFIXES = {"count", "sum", "mean", "mean_ms", "p50", "p95",
+                     "p99", "seconds"}
+
+
+class Registry:
+    """Loaded canonical name sets (one per kind) + pattern matchers."""
+
+    def __init__(self, sets: Dict[str, Dict[str, str]]):
+        self.sets = sets
+        self._regex = {
+            attr: [(n, hv.pattern_to_regex(n))
+                   for n in names if "{" in n]
+            for attr, names in sets.items()}
+
+    def all_names(self) -> Set[str]:
+        out: Set[str] = set()
+        for names in self.sets.values():
+            out |= set(names)
+        return out
+
+    def known(self, attr: str, text: str, is_pattern: bool) -> bool:
+        names = self.sets.get(attr, {})
+        if not is_pattern and text in names:
+            return True
+        if is_pattern:
+            # harvested f-string: match its literal skeleton against the
+            # canonical patterns' skeletons
+            skel = _skeleton(text)
+            return any(_skeleton(n) == skel for n in names if "{" in n)
+        return any(rx.match(text) for _, rx in self._regex.get(attr, ()))
+
+    def kinds_of(self, text: str) -> List[str]:
+        out = []
+        for attr, names in self.sets.items():
+            if text in names or any(rx.match(text)
+                                    for _, rx in self._regex.get(attr, ())):
+                out.append(attr)
+        return out
+
+    def close_match(self, attr_opts, text: str) -> Optional[str]:
+        pool: List[str] = []
+        for attr in attr_opts:
+            pool.extend(self.sets.get(attr, ()))
+        got = difflib.get_close_matches(text, pool, n=1, cutoff=0.86)
+        return got[0] if got else None
+
+
+def _skeleton(pattern: str) -> str:
+    """Collapse every {placeholder} to {} so code f-strings compare
+    equal to canonical named-placeholder patterns."""
+    out, i = [], 0
+    while i < len(pattern):
+        if pattern[i] == "{":
+            j = pattern.find("}", i)
+            if j >= 0:
+                out.append("{}")
+                i = j + 1
+                continue
+        out.append(pattern[i])
+        i += 1
+    return "".join(out)
+
+
+def load_registry(project: Project) -> Optional[Registry]:
+    cached = getattr(project, "_gl_registry", None)
+    if cached is not None:
+        return cached[0]   # (Registry | None,) — None is a valid result
+    registry = _load_registry_uncached(project)
+    project._gl_registry = (registry,)
+    return registry
+
+
+def _load_registry_uncached(project: Project) -> Optional[Registry]:
+    mod = project.find(_NAMES_REL)
+    path = mod.path if mod is not None else os.path.join(
+        project.root, "mmlspark_tpu", _NAMES_REL)
+    if not os.path.exists(path):
+        return None
+    # names.py is pure stdlib data — executing it pulls in nothing
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_graftlint_names", path)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+    except Exception:  # noqa: BLE001 - fall back to an empty registry
+        return None
+    sets = {}
+    for attr in sorted({a for opts in _KIND_ATTRS.values() for a in opts}):
+        value = getattr(m, attr, {})
+        if isinstance(value, dict):
+            sets[attr] = dict(value)
+        else:
+            sets[attr] = {n: "" for n in value}
+    return Registry(sets)
+
+
+def _harvest_all(project: Project) -> List[hv.Use]:
+    return hv.project_uses(project)
+
+
+class MetricNameRule(Rule):
+    """metric-name-unknown + metric-name-typo (one pass, two ids)."""
+
+    name = "metric-name-unknown"
+    typo_name = "metric-name-typo"
+    severity = "error"
+    description = ("Metric/span/fault-site literal not in the canonical "
+                   "telemetry/names.py registry")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        registry = load_registry(project)
+        if registry is None:
+            yield Finding(self.name, _NAMES_REL, 1, 0,
+                          "canonical name registry telemetry/names.py "
+                          "missing or unloadable", self.severity)
+            return
+        for use in _harvest_all(project):
+            if "." not in use.name:
+                continue   # unit-test synthetic names ("w", "boom", ...)
+            if (use.kind in (hv.FAULT, hv.FAULT_REF)
+                    and ("*" in use.name or "?" in use.name)):
+                continue   # glob rule patterns resolve in the sync checker
+            attrs = _KIND_ATTRS[use.kind]
+            if any(registry.known(a, use.name, use.is_pattern)
+                   for a in attrs):
+                continue
+            if registry.kinds_of(use.name):
+                continue   # right name, wrong kind — collision rule's job
+            # derived snapshot keys: <histogram>.p99, <timing>.seconds, ...
+            base, _, suffix = use.name.rpartition(".")
+            if suffix in _DERIVED_SUFFIXES and any(
+                    registry.known(a, base, False)
+                    for a in ("HISTOGRAMS", "TIMINGS")):
+                continue
+            suggestion = (None if use.is_pattern
+                          else registry.close_match(attrs, use.name))
+            mod = project.by_rel.get(use.rel)
+            in_test = mod is not None and mod.is_test
+            if suggestion is not None:
+                yield Finding(
+                    self.typo_name, use.rel, use.line, use.col,
+                    f"{use.kind} name {use.name!r} is not canonical — "
+                    f"possible typo of {suggestion!r}", self.severity)
+            elif not in_test:
+                # tests mint ad-hoc names when unit-testing the tracer /
+                # registry themselves; only package code must be canonical
+                yield Finding(
+                    self.name, use.rel, use.line, use.col,
+                    f"{use.kind} name {use.name!r} is not in "
+                    f"telemetry/names.py — register it (or fix the name)",
+                    self.severity)
+
+
+class MetricKindCollisionRule(Rule):
+    name = "metric-kind-collision"
+    severity = "error"
+    description = ("One name used as two colliding metric kinds "
+                   "(counter/gauge/histogram/timing share the snapshot "
+                   "namespace)")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        registry = load_registry(project)
+        if registry is None:
+            return
+        # registry-internal collisions within the metric family
+        seen: Dict[str, str] = {}
+        for attr in _METRIC_FAMILY:
+            for n in registry.sets.get(attr, ()):
+                if n in seen and seen[n] != attr:
+                    yield Finding(
+                        self.name, "mmlspark_tpu/" + _NAMES_REL, 1, 0,
+                        f"{n!r} is registered as both "
+                        f"{seen[n].lower()} and {attr.lower()}",
+                        self.severity)
+                seen.setdefault(n, attr)
+        # usage-vs-registry kind mismatches — ALL kinds, not just the
+        # metric family: a span name handed to inc() (or a counter name
+        # handed to tracer.span) is the same misuse class and would
+        # otherwise escape both this rule and metric-name-unknown (which
+        # defers any registered name here)
+        for use in _harvest_all(project):
+            if "." not in use.name:
+                continue
+            if (use.kind in (hv.FAULT, hv.FAULT_REF)
+                    and ("*" in use.name or "?" in use.name)):
+                continue
+            attrs = _KIND_ATTRS[use.kind]
+            if any(registry.known(a, use.name, use.is_pattern)
+                   for a in attrs):
+                continue
+            actual = registry.kinds_of(use.name)
+            if actual:
+                yield Finding(
+                    self.name, use.rel, use.line, use.col,
+                    f"{use.name!r} is registered as "
+                    f"{actual[0].lower()[:-1]} but used as a "
+                    f"{use.kind} here", self.severity)
+
+
+class MetricNameUndocumentedRule(Rule):
+    name = "metric-name-undocumented"
+    severity = "error"
+    description = ("docs/observability.md name table out of sync with "
+                   "telemetry/names.py (missing or stale rows)")
+
+    _DOC_HEADING = "## Name registry"
+    _ROW = re.compile(r"\|\s*`([^`]+)`\s*\|")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        registry = load_registry(project)
+        if registry is None:
+            return
+        doc = project.read_file("docs", "observability.md")
+        if doc is None:
+            return
+        for attr in sorted(registry.sets):
+            for n in sorted(registry.sets[attr]):
+                # delimited match: bare substring containment would let a
+                # name that prefixes another documented name (checkpoint.
+                # write vs checkpoint.write.pending) pass undocumented —
+                # the generated table renders every name as `name`
+                if f"`{n}`" not in doc:
+                    yield Finding(
+                        self.name, "docs/observability.md", 1, 0,
+                        f"canonical {attr.lower()[:-1]} name {n!r} is "
+                        f"missing from the observability name table",
+                        self.severity)
+        # reverse direction: a table row whose name left the registry
+        # would otherwise stay documented forever. Only rows under the
+        # registry heading count — the Hooks table's first column holds
+        # code identifiers, not names.
+        head = doc.find(self._DOC_HEADING)
+        if head < 0:
+            return
+        known = registry.all_names()
+        start_line = doc.count("\n", 0, head) + 1
+        lines = doc[head:].splitlines()
+        for off, line in enumerate(lines):
+            if off > 0 and line.startswith("## "):
+                break   # next top-level section: its tables are not names
+            m = self._ROW.match(line.strip())
+            if m and m.group(1) not in known:
+                yield Finding(
+                    self.name, "docs/observability.md", start_line + off, 0,
+                    f"documented name {m.group(1)!r} is not in "
+                    f"telemetry/names.py — stale table row (or a name "
+                    f"that was renamed without the docs)", self.severity)

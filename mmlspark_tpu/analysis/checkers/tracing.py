@@ -1,0 +1,337 @@
+"""Trace hazards: Python control flow / numpy / mutable state inside
+jitted code.
+
+The repo carries 30+ `jax.jit` / `pjit` / `shard_map` sites. Three bug
+classes there are invisible at runtime until they fork executables or
+poison resume determinism (exactly what PR 4 fixed by hand in
+`lm_training.py`):
+
+- `trace-python-branch`: `if` / `while` / `assert` on a traced argument —
+  a concrete-value branch inside tracing either raises
+  `TracerBoolConversionError` or, worse, silently bakes one branch into
+  the executable and forks a recompile per distinct value. Static facts
+  (`x.shape`, `x is None`, `isinstance`, `len`) are exempt, as are
+  parameters declared in `static_argnames` / `static_argnums`.
+- `trace-numpy-call`: `np.*` applied to a traced value forces a host
+  sync + constant-folds the result into ONE executable — use `jnp.*` (or
+  hoist the numpy work out of the jitted function).
+- `trace-mutable-closure`: mutating a closure-captured object
+  (`hist.append(...)`, `state[k] = ...`, `nonlocal n`) inside a traced
+  function — the mutation runs at TRACE time, once per compile, not per
+  step; retraces silently repeat it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import Finding, Module, Rule, dotted_name
+
+_TRACING_WRAPPERS = {"jit", "pjit", "shard_map", "pallas_call"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "itemsize", "nbytes"}
+_STATIC_CALLS = {"isinstance", "len", "getattr", "hasattr", "type",
+                 "callable", "format", "repr", "str"}
+# `.update` is deliberately absent: in jax code a closure-captured
+# `opt.update(grads, state)` is almost always optax's PURE transformation,
+# not dict mutation — including it drowned the rule in false positives
+_MUTATING_METHODS = {"append", "extend", "add", "insert", "pop",
+                     "popleft", "setdefault", "clear", "remove",
+                     "appendleft", "discard"}
+
+
+def _wrapper_name(func) -> Optional[str]:
+    name = dotted_name(func)
+    if name is None:
+        return None
+    leaf = name.split(".")[-1].lstrip("_")
+    return leaf if leaf in _TRACING_WRAPPERS else None
+
+
+def _static_params(call: Optional[ast.Call], fn: ast.AST) -> Set[str]:
+    """Parameter names declared static on the jit call/decorator."""
+    out: Set[str] = set()
+    if call is None:
+        return out
+    params = _param_names(fn)
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.add(v.value)
+        elif kw.arg == "static_argnums":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    if 0 <= v.value < len(params):
+                        out.add(params[v.value])
+    return out
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args   # FunctionDef and Lambda share the arguments shape
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    return names
+
+
+class _TracedFn:
+    def __init__(self, fn, call: Optional[ast.Call], how: str):
+        self.fn = fn                     # FunctionDef | Lambda
+        self.call = call                 # the jit/shard_map call, if any
+        self.how = how                   # "jit" | "shard_map" | ...
+        statics = _static_params(call, fn)
+        self.traced_params = {p for p in _param_names(fn)
+                              if p not in statics}
+
+
+def _find_traced(module: Module) -> List[_TracedFn]:
+    found: List[_TracedFn] = []
+    defs: Dict[str, list] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                how = _wrapper_name(dec)
+                if how is not None:
+                    found.append(_TracedFn(node, None, how))
+                    continue
+                if isinstance(dec, ast.Call):
+                    how = _wrapper_name(dec.func)
+                    if how is not None:
+                        found.append(_TracedFn(node, dec, how))
+                        continue
+                    # functools.partial(jax.jit, static_argnames=...)
+                    leaf = (dotted_name(dec.func) or "").split(".")[-1]
+                    if leaf == "partial" and dec.args:
+                        how = _wrapper_name(dec.args[0])
+                        if how is not None:
+                            found.append(_TracedFn(node, dec, how))
+        elif isinstance(node, ast.Call):
+            how = _wrapper_name(node.func)
+            if how is None or not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                found.append(_TracedFn(target, node, how))
+            elif isinstance(target, ast.Name):
+                for d in defs.get(target.id, []):
+                    found.append(_TracedFn(d, node, how))
+    # dedupe (a def may be seen via decorator and call)
+    seen: Set[int] = set()
+    out = []
+    for t in found:
+        if id(t.fn) not in seen:
+            seen.add(id(t.fn))
+            out.append(t)
+    return out
+
+
+def _is_static_use(name_node: ast.Name, stop_at) -> bool:
+    """True when this traced-name use is a static fact: `.shape`-like
+    attribute access, `is None` comparison, or inside `isinstance`/`len`/
+    ... calls. Climbs parents up to the enclosing statement."""
+    child = name_node
+    cur = getattr(name_node, "_gl_parent", None)
+    while cur is not None and cur is not stop_at:
+        if isinstance(cur, ast.Attribute) and cur.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(cur, ast.Call):
+            leaf = (dotted_name(cur.func) or "").split(".")[-1]
+            if leaf in _STATIC_CALLS:
+                return True
+        if isinstance(cur, ast.Compare):
+            ops_static = all(isinstance(op, (ast.Is, ast.IsNot))
+                             for op in cur.ops)
+            if ops_static:
+                return True
+        child, cur = cur, getattr(cur, "_gl_parent", None)
+    return False
+
+
+def _traced_names_in(expr, traced: Set[str], stop_at) -> List[ast.Name]:
+    hits = []
+    for n in ast.walk(expr):
+        if (isinstance(n, ast.Name) and n.id in traced
+                and isinstance(n.ctx, ast.Load)
+                and not _is_static_use(n, stop_at)):
+            hits.append(n)
+    return hits
+
+
+def _body_nodes(fn):
+    """All nodes inside a traced function, including nested defs (they
+    execute during tracing)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+class TracePythonBranchRule(Rule):
+    name = "trace-python-branch"
+    severity = "error"
+    description = ("Python if/while/assert on a traced argument inside "
+                   "jit/pjit/shard_map (concrete-value branch during "
+                   "tracing)")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.is_test:
+            return
+        for t in _find_traced(module):
+            shadowed = _shadowed_params(t)
+            traced = t.traced_params - shadowed
+            for node in _body_nodes(t.fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                elif isinstance(node, ast.IfExp):
+                    test = node.test
+                else:
+                    continue
+                hits = _traced_names_in(test, traced, node)
+                if hits:
+                    kind = type(node).__name__.lower()
+                    yield module.finding(
+                        self, node,
+                        f"`{kind}` on traced argument "
+                        f"`{hits[0].id}` inside a {t.how}-traced function "
+                        f"— use lax.cond/where, or declare it static")
+
+
+def _shadowed_params(t: _TracedFn) -> Set[str]:
+    """Params rebound inside the function body (loop targets etc.) stop
+    being reliably 'the traced argument' for reporting purposes."""
+    out: Set[str] = set()
+    for node in _body_nodes(t.fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if node.id in t.traced_params:
+                out.add(node.id)
+    return out
+
+
+class TraceNumpyCallRule(Rule):
+    name = "trace-numpy-call"
+    severity = "error"
+    description = ("np.* applied to a traced value inside "
+                   "jit/pjit/shard_map (host sync + constant folding)")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.is_test:
+            return
+        np_aliases = _numpy_aliases(module)
+        if not np_aliases:
+            return
+        for t in _find_traced(module):
+            shadowed = _shadowed_params(t)
+            traced = t.traced_params - shadowed
+            for node in _body_nodes(t.fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                if fname is None:
+                    continue
+                root = fname.split(".")[0]
+                if root not in np_aliases or fname == root:
+                    continue
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                for a in args:
+                    hits = _traced_names_in(a, traced, node)
+                    if hits:
+                        yield module.finding(
+                            self, node,
+                            f"`{fname}(...)` applied to traced argument "
+                            f"`{hits[0].id}` inside a {t.how}-traced "
+                            f"function — use jnp.* or hoist to host code")
+                        break
+
+
+def _numpy_aliases(module: Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+class TraceMutableClosureRule(Rule):
+    name = "trace-mutable-closure"
+    severity = "error"
+    description = ("Mutation of a closure-captured object inside a traced "
+                   "function (runs at trace time, once per compile)")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.is_test:
+            return
+        module_globals = _module_globals(module)
+        for t in _find_traced(module):
+            local = set(_param_names(t.fn)) | _local_bindings(t.fn)
+            for node in _body_nodes(t.fn):
+                if isinstance(node, ast.Nonlocal):
+                    for nm in node.names:
+                        yield module.finding(
+                            self, node,
+                            f"`nonlocal {nm}` inside a {t.how}-traced "
+                            f"function — the rebind happens at trace "
+                            f"time, not per step")
+                    continue
+                recv_name = None
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATING_METHODS
+                        and isinstance(node.func.value, ast.Name)):
+                    recv_name, loc = node.func.value.id, node
+                elif (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Store)
+                        and isinstance(node.value, ast.Name)):
+                    recv_name, loc = node.value.id, node
+                if recv_name is None:
+                    continue
+                if recv_name in local or recv_name in module_globals:
+                    continue
+                yield module.finding(
+                    self, loc,
+                    f"mutation of closure-captured `{recv_name}` inside "
+                    f"a {t.how}-traced function — side effects run at "
+                    f"trace time and repeat on retrace")
+
+
+def _local_bindings(fn) -> Set[str]:
+    out: Set[str] = set()
+    for node in _body_nodes(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+            out.update(_param_names(node))
+        elif isinstance(node, ast.Lambda):
+            out.update(_param_names(node))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                out.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+    return out
+
+
+def _module_globals(module: Module) -> Set[str]:
+    """TOP-LEVEL bindings only — descending into function bodies would
+    classify enclosing-function locals as globals and hide real closure
+    captures."""
+    import builtins
+    out: Set[str] = set(dir(builtins))
+    for node in module.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+            continue
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                out.add(n.id)
+            elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                for a in n.names:
+                    out.add((a.asname or a.name).split(".")[0])
+    return out

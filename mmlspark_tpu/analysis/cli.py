@@ -1,0 +1,118 @@
+"""graftlint CLI (`python -m mmlspark_tpu.analysis`, console script
+`graftlint`).
+
+Exit codes: 0 clean (or only baselined findings), 1 findings, 2 usage
+error. `--strict` also fails on warnings; without it only
+severity=error findings gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from . import BASELINE_FILENAME, run
+from .checkers import default_rules
+from .core import Baseline
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="Project-invariant static analyzer for mmlspark_tpu "
+                    "(lock discipline, trace hazards, determinism, name "
+                    "registries, fault-site sync, resource hygiene).")
+    p.add_argument("paths", nargs="*", default=["mmlspark_tpu", "tests"],
+                   help="files/directories to analyze (default: "
+                        "mmlspark_tpu tests)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: cwd); relative paths and the "
+                        "baseline resolve against it")
+    p.add_argument("--strict", action="store_true",
+                   help="fail on warnings too, not just errors")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help=f"baseline file (default: {BASELINE_FILENAME} in "
+                        f"root when present; pass '' to disable)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write every current finding to the baseline file "
+                        "and exit 0")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings covered by the baseline")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule names to run (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:26s} [{r.severity}] {r.description}")
+        return 0
+    if args.select:
+        wanted = {s.strip() for s in args.select.split(",") if s.strip()}
+        # MetricNameRule owns a second reporting id: selecting the typo id
+        # must select the rule that emits it, not silently run nothing
+        if "metric-name-typo" in wanted:
+            wanted.add("metric-name-unknown")
+            wanted.discard("metric-name-typo")
+        rules = [r for r in rules if r.name in wanted]
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+    root = os.path.abspath(args.root or os.getcwd())
+    missing = [p for p in args.paths if not os.path.exists(
+        p if os.path.isabs(p) else os.path.join(root, p))]
+    if missing:
+        # a typo'd path walks zero files and would gate green forever
+        print(f"graftlint: path(s) not found under {root}: "
+              + ", ".join(missing), file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        if args.select:
+            # a subset run would overwrite the OTHER rules' baselined debt
+            # wholesale — refuse rather than silently shrink the ledger
+            print("graftlint: --write-baseline cannot be combined with "
+                  "--select (it would drop other rules' baseline entries)",
+                  file=sys.stderr)
+            return 2
+        if args.baseline == "":
+            print("graftlint: --write-baseline needs a baseline path "
+                  "(got '')", file=sys.stderr)
+            return 2
+        report = run(args.paths, root=root, baseline_path="", rules=rules)
+        path = os.path.join(root, args.baseline or BASELINE_FILENAME)
+        Baseline.from_findings(report.findings).save(path)
+        print(f"graftlint: baselined {len(report.findings)} finding(s) "
+              f"-> {path}")
+        return 0
+    try:
+        report = run(args.paths, root=root, baseline_path=args.baseline,
+                     rules=rules)
+    except OSError as e:
+        print(f"graftlint: cannot read baseline: {e}", file=sys.stderr)
+        return 2
+    try:
+        if args.format == "json":
+            print(json.dumps(report.to_dict(), indent=1))
+        else:
+            print(report.render_text(show_baselined=args.show_baselined))
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — swallow the write error
+        # (and park stdout on devnull so the shutdown flush stays quiet)
+        # but still exit with the real gating code
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    gating = [f for f in report.active
+              if args.strict or f.severity == "error"]
+    return 1 if gating or report.skipped else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,354 @@
+"""graftlint core: the rule framework the project-invariant checkers plug
+into.
+
+The framework's MMLSpark analog is the codegen layer (PAPER.md): contracts
+that review cannot reliably hold — lock discipline, trace purity,
+deterministic resume, one canonical name per metric — are enforced by
+tooling over the source tree instead. *A Learned Performance Model for TPUs*
+(PAPERS.md) makes the enabling observation: program structure is statically
+analyzable; the invariants this framework carries (PRs 1-5) are all visible
+in the AST.
+
+Pieces:
+
+- `Finding`: one violation with file:line:col, rule id, severity, message.
+  Its `key()` deliberately EXCLUDES the line number — a baseline must
+  survive unrelated edits shifting code up or down a file.
+- `Rule`: subclass with `name`/`severity`/`description`; implement
+  `check(module)` for per-file findings and/or `finalize(project)` for
+  whole-project ones (lock-order graphs, name registries, test<->code
+  sync).
+- Suppressions: `# graftlint: disable=<rule>[,<rule2>]` on the finding's
+  line silences those rules there; `# graftlint: disable-file=<rule>`
+  anywhere in a file silences the rule for the whole file. `all` works in
+  both forms. Suppressions are for findings that are CORRECT AS WRITTEN
+  (an intentional single-flight build under a lock); the baseline is for
+  inherited debt that should someday be fixed.
+- Baseline: a committed JSON map of `finding key -> count`. Findings up to
+  the baselined count are reported as `baselined` and do not gate; NEW
+  findings (or more of an old kind) fail `--strict`.
+
+Everything here is stdlib-only: the analyzer must run in CI images without
+jax/numpy installed and must never import the code it is analyzing.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Iterable, List, Optional
+
+SEVERITIES = ("error", "warning")
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_\-,\s]+)")
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "severity",
+                 "baselined")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, severity: str = "error"):
+        if severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}")
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.severity = severity
+        self.baselined = False
+
+    def key(self) -> str:
+        """Baseline identity: rule + file + message, NOT the line number —
+        the committed baseline must survive unrelated edits moving code."""
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "severity": self.severity, "baselined": self.baselined}
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+
+class Module:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel                       # posix-style, relative to root
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.syntax_error = e
+        self.line_disables: dict = {}        # line -> set(rule names)
+        self.file_disables: set = set()
+        self._scan_suppressions()
+        if self.tree is not None:
+            annotate_parents(self.tree)
+
+    @property
+    def is_test(self) -> bool:
+        parts = self.rel.split("/")
+        return ("tests" in parts or parts[-1].startswith("test_")
+                or parts[-1] in ("conftest.py", "fuzzing.py"))
+
+    def _scan_suppressions(self) -> None:
+        for lineno, text in enumerate(self.lines, start=1):
+            if "graftlint" not in text:
+                continue
+            m = _DISABLE_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            if m.group("scope"):
+                self.file_disables |= rules
+            else:
+                self.line_disables.setdefault(lineno, set()).update(rules)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if {"all", finding.rule} & self.file_disables:
+            return True
+        at_line = self.line_disables.get(finding.line, ())
+        return "all" in at_line or finding.rule in at_line
+
+    def finding(self, rule: "Rule", node, message: str,
+                severity: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", 0) or 0
+        col = getattr(node, "col_offset", 0) or 0
+        return Finding(rule.name, self.rel, line, col, message,
+                       severity or rule.severity)
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    """Stamp `_gl_parent` on every node (checkers walk upward for context)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._gl_parent = node
+
+
+def parent_chain(node) -> Iterable[ast.AST]:
+    cur = getattr(node, "_gl_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_gl_parent", None)
+
+
+def enclosing_function(node) -> Optional[ast.AST]:
+    for p in parent_chain(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return p
+    return None
+
+
+def dotted_name(node) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Project:
+    """Everything `finalize` rules see: all modules plus repo-level files."""
+
+    def __init__(self, root: str, modules: List[Module]):
+        self.root = root
+        self.modules = modules
+        self.by_rel = {m.rel: m for m in modules}
+
+    def package_modules(self) -> List[Module]:
+        return [m for m in self.modules if not m.is_test]
+
+    def test_modules(self) -> List[Module]:
+        return [m for m in self.modules if m.is_test]
+
+    def find(self, rel_suffix: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.rel.endswith(rel_suffix):
+                return m
+        return None
+
+    def read_file(self, *rel_parts: str) -> Optional[str]:
+        path = os.path.join(self.root, *rel_parts)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+class Rule:
+    """Base checker. Subclasses set `name` (the id used in disable
+    comments and baselines), `severity`, `description`, and implement
+    `check` and/or `finalize`."""
+
+    name = "abstract"
+    severity = "error"
+    description = ""
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+class Baseline:
+    """Committed debt ledger: `finding key -> count`."""
+
+    def __init__(self, counts: Optional[dict] = None):
+        self.counts = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("findings", data) if isinstance(data, dict)
+                   else {})
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        counts: dict = {}
+        for f in findings:
+            counts[f.key()] = counts.get(f.key(), 0) + 1
+        return cls(counts)
+
+    def save(self, path: str) -> None:
+        payload = {"format": "graftlint-baseline-v1",
+                   "findings": dict(sorted(self.counts.items()))}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def apply(self, findings: List[Finding]) -> None:
+        """Mark findings covered by the baseline (first N per key win,
+        in file order — stable because findings are sorted before this)."""
+        budget = dict(self.counts)
+        for f in findings:
+            k = f.key()
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                f.baselined = True
+
+
+class Report:
+    def __init__(self, findings: List[Finding], files: int,
+                 skipped: List[str]):
+        self.findings = findings
+        self.files = files
+        self.skipped = skipped   # unparseable files (reported separately)
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    def counts(self) -> dict:
+        out: dict = {}
+        for f in self.active:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {"files": self.files,
+                "findings": [f.to_dict() for f in self.findings],
+                "active": len(self.active),
+                "baselined": len(self.findings) - len(self.active),
+                "by_rule": self.counts(),
+                "skipped": list(self.skipped)}
+
+    def render_text(self, show_baselined: bool = False) -> str:
+        lines = []
+        for f in self.findings:
+            if f.baselined and not show_baselined:
+                continue
+            tag = " (baselined)" if f.baselined else ""
+            lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} "
+                         f"[{f.severity}]{tag} {f.message}")
+        for s in self.skipped:
+            lines.append(f"{s}: skipped (syntax error)")
+        active = self.active
+        lines.append(f"graftlint: {self.files} files, "
+                     f"{len(active)} finding(s)"
+                     + (f", {len(self.findings) - len(active)} baselined"
+                        if len(self.findings) != len(active) else ""))
+        return "\n".join(lines)
+
+
+def iter_py_files(paths: Iterable[str], root: str) -> Iterable[str]:
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                yield full
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git",
+                                              ".jax_cache", "build"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+class Analyzer:
+    """Load files, run rules, apply suppressions + baseline."""
+
+    def __init__(self, rules: Iterable[Rule], root: Optional[str] = None):
+        self.rules = list(rules)
+        self.root = os.path.abspath(root or os.getcwd())
+
+    def load(self, paths: Iterable[str]) -> Project:
+        modules = []
+        seen = set()
+        for full in iter_py_files(paths, self.root):
+            full = os.path.abspath(full)
+            if full in seen:
+                continue
+            seen.add(full)
+            try:
+                with open(full, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            rel = os.path.relpath(full, self.root).replace(os.sep, "/")
+            modules.append(Module(full, rel, source))
+        return Project(self.root, modules)
+
+    def run(self, paths: Iterable[str],
+            baseline: Optional[Baseline] = None) -> Report:
+        project = self.load(paths)
+        findings: List[Finding] = []
+        skipped = [m.rel for m in project.modules if m.tree is None]
+        for rule in self.rules:
+            for m in project.modules:
+                if m.tree is None:
+                    continue
+                for f in rule.check(m):
+                    if not m.suppressed(f):
+                        findings.append(f)
+            for f in rule.finalize(project):
+                m = project.by_rel.get(f.path)
+                if m is None or not m.suppressed(f):
+                    findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        if baseline is not None:
+            baseline.apply(findings)
+        return Report(findings, files=len(project.modules), skipped=skipped)

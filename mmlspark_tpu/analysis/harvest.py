@@ -1,0 +1,232 @@
+"""Shared harvesting of metric / span / fault-site name usage from the AST.
+
+Both the name-registry checker and the fault-site sync checker need the
+same inventory: every string a call site hands to `inc(...)`,
+`observe_ms(...)`, `set_gauge(...)`, `tracer.span(...)`, `perturb(...)`,
+... — including f-strings, which become *patterns* (`f"train.step{step}"`
+-> ``train.step{}``) matched loosely against the canonical pattern list.
+
+Harvesting is deliberately receiver-aware: `.get("content-length")` on an
+HTTP header dict must not be mistaken for a metric read, so metric methods
+only count on receivers that look like a metrics registry
+(`reliability_metrics`, `metrics`, `_metrics`, `self.metrics`, ...), and
+span methods only on tracer-shaped receivers (`tracer`, `_tel`,
+`get_tracer()`, ...). Fault methods (`perturb`/`fire`) are unambiguous by
+name.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, NamedTuple, Optional
+
+from .core import Module, dotted_name
+
+# kinds a harvested name can be used as. FAULT is a site FIRED
+# (perturb/fire/corrupt_* call sites and signature defaults); FAULT_REF is
+# a site REFERENCED by a rule schedule ({"site": ...} dict entries) — the
+# sync checker holds refs and fires to each other.
+COUNTER, GAUGE, HISTOGRAM, TIMING, SPAN, EVENT, FAULT, FAULT_REF = (
+    "counter", "gauge", "histogram", "timing", "span", "event", "fault",
+    "fault_ref")
+
+_METRIC_RECEIVERS = {"reliability_metrics", "metrics", "_metrics",
+                     "recovery_metrics"}
+_TRACER_RECEIVERS = {"tracer", "_tel", "_tracer", "get_tracer"}
+
+_METRIC_METHODS = {
+    "inc": COUNTER, "counter": COUNTER, "get": COUNTER,
+    "set_gauge": GAUGE, "gauge": GAUGE,
+    "observe_ms": HISTOGRAM, "histogram": HISTOGRAM,
+    "percentile": HISTOGRAM,
+}
+_TRACER_METHODS = {"span": SPAN, "start_span": SPAN, "record": SPAN,
+                   "event": EVENT, "trace": SPAN}
+_FAULT_METHODS = {"perturb", "fire", "corrupt_bytes"}
+
+
+class Use(NamedTuple):
+    kind: str          # counter | gauge | histogram | timing | span | event | fault
+    name: str          # literal, or pattern with {} placeholders
+    is_pattern: bool
+    rel: str
+    line: int
+    col: int
+
+
+def literal_or_pattern(node) -> Optional[tuple]:
+    """(text, is_pattern) for a Constant str or JoinedStr; None otherwise.
+    F-string interpolations collapse to `{}` placeholders."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("{}")
+        return "".join(parts), True
+    return None
+
+
+def _receiver_token(func: ast.AST) -> Optional[str]:
+    """The last identifier of the receiver expression of a method call:
+    `reliability_metrics` for `reliability_metrics.inc`, `metrics` for
+    `self.metrics.inc`, `get_tracer` for `get_tracer().span`."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = func.value
+    if isinstance(recv, ast.Call):
+        name = dotted_name(recv.func)
+        return name.split(".")[-1] if name else None
+    name = dotted_name(recv)
+    return name.split(".")[-1] if name else None
+
+
+def pattern_to_regex(pattern: str) -> "re.Pattern":
+    """Canonical-pattern matcher: `{placeholder}` spans any non-empty run."""
+    out, buf = [], []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "{":
+            j = pattern.find("}", i)
+            if j < 0:
+                buf.append(ch)
+                i += 1
+                continue
+            out.append(re.escape("".join(buf)))
+            buf = []
+            out.append(r".+?")
+            i = j + 1
+        else:
+            buf.append(ch)
+            i += 1
+    out.append(re.escape("".join(buf)))
+    return re.compile("^" + "".join(out) + "$")
+
+
+def harvest_module(module: Module) -> List[Use]:
+    """Every metric/span/fault name usage in one module."""
+    uses: List[Use] = []
+    if module.tree is None:
+        return uses
+
+    def add(kind: str, node, arg) -> None:
+        got = literal_or_pattern(arg)
+        if got is None:
+            return
+        text, is_pattern = got
+        uses.append(Use(kind, text, is_pattern, module.rel,
+                        getattr(node, "lineno", 0),
+                        getattr(node, "col_offset", 0)))
+
+    for node in ast.walk(module.tree):
+        # fault sites defaulted in signatures: `def f(..., site="checkpoint")`
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = args.posonlyargs + args.args
+            for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                    args.defaults):
+                if arg.arg == "site":
+                    add(FAULT, default, default)
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                # keyword-only form: `def f(*, site="cluster.heartbeat")`
+                if arg.arg == "site" and default is not None:
+                    add(FAULT, default, default)
+            continue
+        # fault-site references inside rule dicts: {"site": "serving.worker"}
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "site"):
+                    add(FAULT_REF, v if hasattr(v, "lineno") else node, v)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # wall_clock("label", sink=metrics.observe) -> timing label
+        fname = dotted_name(func)
+        leaf = fname.split(".")[-1] if fname else (
+            func.attr if isinstance(func, ast.Attribute) else None)
+        if leaf == "wall_clock" and node.args:
+            add(TIMING, node, node.args[0])
+        if leaf == "corrupt_file":
+            site_given = False
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    add(FAULT, node, kw.value)
+                    site_given = True
+            if len(node.args) >= 2:
+                add(FAULT, node, node.args[1])
+                site_given = True
+            if not site_given:
+                # corrupt_file's signature default — callers omitting
+                # `site` still fire the "checkpoint" site
+                uses.append(Use(FAULT, "checkpoint", False, module.rel,
+                                node.lineno, node.col_offset))
+        # metric_name="..." kwargs (RetryPolicy / CircuitBreaker counters)
+        for kw in node.keywords:
+            if kw.arg == "metric_name":
+                add(COUNTER, node, kw.value)
+        if not isinstance(func, ast.Attribute):
+            continue
+        method = func.attr
+        recv = _receiver_token(func)
+        if method in _FAULT_METHODS and node.args:
+            add(FAULT, node, node.args[0])
+        elif (method in _METRIC_METHODS and recv in _METRIC_RECEIVERS
+                and node.args):
+            add(_METRIC_METHODS[method], node, node.args[0])
+        elif (method == "observe" and recv in _METRIC_RECEIVERS
+                and len(node.args) == 2):
+            # the (label, seconds) wall-clock sink form
+            add(TIMING, node, node.args[0])
+        elif (method == "observe" and recv in _TRACER_RECEIVERS
+                and len(node.args) == 2):
+            # tracer.observe(label, seconds) records a span named label
+            add(SPAN, node, node.args[0])
+        elif (method in _TRACER_METHODS and recv in _TRACER_RECEIVERS
+                and node.args):
+            kind = _TRACER_METHODS[method]
+            if method == "record":
+                for kw in node.keywords:
+                    if (kw.arg == "kind" and isinstance(kw.value, ast.Constant)
+                            and kw.value.value == "event"):
+                        kind = EVENT
+            add(kind, node, node.args[0])
+    return uses
+
+
+def harvest(modules: Iterable[Module]) -> List[Use]:
+    out: List[Use] = []
+    for m in modules:
+        out.extend(harvest_module(m))
+    return out
+
+
+def harvest_project(project) -> dict:
+    """Per-module harvest for a whole Project, computed ONCE and cached on
+    the project — five finalize rules (names x3, faultsync x2) consume the
+    same inventory, and re-walking 180 ASTs per rule was the analyzer's
+    dominant cost."""
+    cache = getattr(project, "_gl_harvest", None)
+    if cache is None:
+        cache = project._gl_harvest = {
+            m.rel: harvest_module(m)
+            for m in project.modules if m.tree is not None}
+    return cache
+
+
+def project_uses(project, test_modules=None) -> List[Use]:
+    """Flattened cached harvest; `test_modules=True/False` filters to
+    test-only / package-only modules."""
+    per_mod = harvest_project(project)
+    out: List[Use] = []
+    for m in project.modules:
+        if m.tree is None:
+            continue
+        if test_modules is not None and m.is_test != test_modules:
+            continue
+        out.extend(per_mod.get(m.rel, ()))
+    return out

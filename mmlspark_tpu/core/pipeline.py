@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import json
 import logging
-import time
 from typing import List, Optional, Sequence
 
+from ..telemetry.spans import wall_now
 from .params import Param, Params
 from .table import Table
 
@@ -35,7 +35,9 @@ def _log_event(stage, method: str):
         "uid": getattr(stage, "uid", None),
         "className": type(stage).__name__,
         "method": method,
-        "ts": time.time(),
+        # monotonic-derived epoch value: consecutive usage events never log
+        # out-of-order timestamps across an NTP step
+        "ts": wall_now(),
     }))
 
 

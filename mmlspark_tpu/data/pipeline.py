@@ -27,6 +27,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..reliability.metrics import reliability_metrics
+from ..telemetry import names as tnames
 from ..utils import tracing
 from .chunk import ChunkSource, default_chunk_rows, make_chunks
 from .pool import WorkerPool
@@ -87,7 +88,7 @@ def parallel_apply_bins(mapper, x: np.ndarray,
     bit-identical to the sequential call (binning is row-independent)."""
     opts = opts or IngestOptions()
     pool = opts.pool(faults=faults)
-    with tracing.wall_clock("data.apply_bins",
+    with tracing.wall_clock(tnames.DATA_APPLY_BINS,
                             sink=reliability_metrics.observe):
         # no dtype cast: chunks bin at the INPUT's dtype, exactly like the
         # sequential call (an f32 downcast of f64 features could flip a
@@ -143,7 +144,7 @@ def stage_binned(mapper, x: np.ndarray, opts: Optional[IngestOptions] = None,
     n = x.shape[0]
     fn = functools.partial(_bin_rows, mapper)
     in_place = jax.devices()[0].platform != "cpu"
-    with tracing.wall_clock("data.stage_binned",
+    with tracing.wall_clock(tnames.DATA_STAGE_BINNED,
                             sink=reliability_metrics.observe):
         source = (rows for _c, rows in pool.imap_rows(
             fn, x, chunk_rows=opts.chunk_rows))
@@ -192,7 +193,7 @@ class ParallelTransform:
             parts[chunk.index] = self.fn(
                 _table_slice(table, chunk.lo, chunk.hi))
 
-        with tracing.wall_clock("data.table_transform",
+        with tracing.wall_clock(tnames.DATA_TABLE_TRANSFORM,
                                 sink=reliability_metrics.observe):
             self._pool.run_chunks(chunks, one)
         return reassemble_tables(parts, npartitions=table.npartitions)

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..reliability.faults import FaultInjector
 from ..reliability.metrics import reliability_metrics
+from ..telemetry import names as tnames
 from ..utils import tracing
 from .chunk import Chunk, default_chunk_rows, make_chunks
 
@@ -170,8 +171,8 @@ class WorkerPool:
         out_shape = (n, out_width) if out_width else (n,)
         out = np.empty(out_shape, dtype=out_dtype)
         mode = self._pick_mode(fn, x.nbytes)
-        self.metrics.inc(f"data.pool.{mode}_maps")
-        with tracing.wall_clock(f"data.pool.map[{mode}]",
+        self.metrics.inc(tnames.data_pool_maps(mode))
+        with tracing.wall_clock(tnames.data_pool_map_timing(mode),
                                 sink=self.metrics.observe):
             if mode == "process" and len(chunks) > 1:
                 self._map_process(fn, x, out, chunks)
@@ -205,7 +206,7 @@ class WorkerPool:
                 list(pool.map(run, chunks))
         if errors:
             index = min(errors)
-            self.metrics.inc("data.worker_failures", len(errors))
+            self.metrics.inc(tnames.DATA_WORKER_FAILURES, len(errors))
             raise WorkerCrashError(index, repr(errors[index])) \
                 from errors[index]
 
@@ -305,7 +306,7 @@ class WorkerPool:
                                           f"{missing}")
             if errors:
                 index = min(errors)
-                self.metrics.inc("data.worker_failures", len(errors))
+                self.metrics.inc(tnames.DATA_WORKER_FAILURES, len(errors))
                 raise WorkerCrashError(index, str(errors[index]))
             out[...] = shared_out
         finally:
@@ -338,7 +339,7 @@ class WorkerPool:
 
         def one(chunk: Chunk):
             _fire_chunk_faults(self.faults, chunk.index)
-            with tracing.wall_clock("data.bin_chunk",
+            with tracing.wall_clock(tnames.DATA_BIN_CHUNK,
                                     sink=self.metrics.observe):
                 res = np.asarray(fn(x[chunk.lo:chunk.hi]))
             if res.shape[0] != chunk.n_rows:
@@ -365,5 +366,5 @@ class WorkerPool:
         except WorkerCrashError:
             raise
         except BaseException as e:  # noqa: BLE001
-            self.metrics.inc("data.worker_failures")
+            self.metrics.inc(tnames.DATA_WORKER_FAILURES)
             raise WorkerCrashError(chunk.index, repr(e)) from e

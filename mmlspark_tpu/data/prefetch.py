@@ -30,6 +30,7 @@ from typing import Callable, Iterable, Iterator, Optional
 
 from ..reliability.metrics import reliability_metrics
 from ..telemetry.spans import get_tracer
+from ..telemetry import names as tnames
 from ..utils import tracing
 
 _DONE = object()
@@ -65,12 +66,12 @@ class DevicePrefetcher:
             for item in self._source:
                 if self._stop.is_set():
                     return
-                with tracing.wall_clock("data.prefetch.put",
+                with tracing.wall_clock(tnames.DATA_PREFETCH_PUT,
                                         sink=self._metrics.observe):
                     dev = self._put(item)
-                self._metrics.inc("data.prefetch.items")
+                self._metrics.inc(tnames.DATA_PREFETCH_ITEMS)
                 if self._q.full():
-                    self._metrics.inc("data.prefetch.full")
+                    self._metrics.inc(tnames.DATA_PREFETCH_FULL)
                 self._q_put(dev)
             self._q_put(_DONE)
         except BaseException as e:  # noqa: BLE001 - re-raised in consumer
@@ -95,7 +96,7 @@ class DevicePrefetcher:
             # the items/stalls totals, so a trace shows whether the overlap
             # actually hid the producer
             self._span = get_tracer().start_span(
-                "data.prefetch", attrs={"depth": self._q.maxsize})
+                tnames.DATA_PREFETCH_SPAN, attrs={"depth": self._q.maxsize})
             self._thread.start()
         return self
 
@@ -118,7 +119,7 @@ class DevicePrefetcher:
             raise item
         if was_empty:
             self._stalls += 1
-            self._metrics.inc("data.prefetch.stalls")
+            self._metrics.inc(tnames.DATA_PREFETCH_STALLS)
         self._consumed += 1
         return item
 

@@ -33,6 +33,7 @@ from ..core import Param, Table, Transformer, HasInputCol, HasOutputCol
 from ..core.params import in_range, one_of
 from ..reliability.policy import RetryPolicy
 from ..utils.async_utils import bounded_map
+from ..telemetry.names import HTTP_RETRIES
 
 
 @dataclasses.dataclass
@@ -106,7 +107,7 @@ def advanced_handler(req: HTTPRequest, timeout: float = 60.0,
     `backoff` build a default one."""
     if policy is None:
         policy = RetryPolicy(max_attempts=retry_times, backoff=backoff,
-                             metric_name="http.retries")
+                             metric_name=HTTP_RETRIES)
     last_err = None
     resp: Optional[HTTPResponse] = None
     for attempt in policy.attempts():
@@ -158,7 +159,7 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                          None, transient=True)
     retry_metric_name = Param("retry_metric_name",
                               "reliability counter retries land under",
-                              "http.retries")
+                              HTTP_RETRIES)
 
     def _build_policy(self) -> RetryPolicy:
         if self.retry_policy is not None:

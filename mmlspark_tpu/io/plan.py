@@ -46,6 +46,7 @@ from ..core.params import Params
 from ..reliability.metrics import reliability_metrics
 from ..stages.batching import pad_rows_to_bucket, shape_bucket
 from ..telemetry.spans import get_tracer
+from ..telemetry import names as tnames
 from .serving import Reply, _jsonable
 
 
@@ -139,6 +140,10 @@ class ServingTransform:
                         else None)
         self._plans: dict = {}
         self._lock = threading.Lock()
+        # single-flight plan construction: key -> Event the builder sets
+        # once the plan (or its failure) lands; concurrent missers wait
+        # instead of compiling the same plan twice
+        self._building: dict = {}
         self._hits = 0
         self._misses = 0
         # reply framing serialized once: the write path appends only the
@@ -192,28 +197,46 @@ class ServingTransform:
         return assemble, run
 
     def _plan_for(self, n_rows: int) -> tuple:
+        """Resolve (or build) the plan for this batch size.
+
+        Miss-stampede contract: when N worker threads miss the same
+        (fingerprint, bucket) concurrently, exactly ONE builds —
+        `serving.plan.misses` counts real compiles, so it stays pinned at
+        one per key no matter how many partitions race the cold cache.
+        Waiters block on the builder's Event and count as hits (they got
+        a plan without compiling). A builder that fails clears its Event
+        so a waiter retries the build rather than caching the failure."""
         bucket = shape_bucket(n_rows, self.max_bucket)
         key = (self.fingerprint, bucket)
-        with self._lock:
-            plan = self._plans.get(key)
+        while True:
+            with self._lock:
+                plan = self._plans.get(key)
+                if plan is not None:
+                    self._hits += 1
+                    wait_for = None
+                else:
+                    wait_for = self._building.get(key)
+                    if wait_for is None:
+                        # this thread is the builder
+                        self._building[key] = threading.Event()
             if plan is not None:
-                self._hits += 1
-        if plan is not None:
-            self._metrics.inc("serving.plan.hits")
-            return plan
-        built = self._build_plan(bucket)
-        with self._lock:
-            plan = self._plans.get(key)
-            if plan is None:
-                plan = self._plans[key] = built
+                self._metrics.inc(tnames.SERVING_PLAN_HITS)
+                return plan
+            if wait_for is not None:
+                wait_for.wait()   # builder is compiling; loop re-checks
+                continue
+            try:
+                built = self._build_plan(bucket)
+            except BaseException:
+                with self._lock:
+                    self._building.pop(key).set()   # wake waiters to retry
+                raise
+            with self._lock:
+                self._plans[key] = built
                 self._misses += 1
-                missed = True
-            else:
-                self._hits += 1   # another partition's worker built it first
-                missed = False
-        self._metrics.inc("serving.plan.misses" if missed
-                          else "serving.plan.hits")
-        return plan
+                self._building.pop(key).set()
+            self._metrics.inc(tnames.SERVING_PLAN_MISSES)
+            return built
 
     def stats(self) -> dict:
         with self._lock:
@@ -224,51 +247,63 @@ class ServingTransform:
     def __call__(self, bodies: Sequence[bytes]) -> list:
         rows, replies = _decode_rows(bodies, self.input_cols)
         good_idx = [i for i, r in enumerate(rows) if r is not None]
-        if good_idx:
-            good_rows = [rows[i] for i in good_idx]
-            assemble, run = self._plan_for(len(good_rows))
+        if not good_idx:
+            return replies
+        good_rows = [rows[i] for i in good_idx]
+        assemble, run = self._plan_for(len(good_rows))
+        try:
+            data = assemble(good_rows)
+        except (ValueError, TypeError):
+            # a parseable body with a BAD VALUE (ragged vector, wrong
+            # type/width) breaks the columnar assembly — find the
+            # offender(s) per row, 400 them, and run the model ONCE on
+            # the survivors so batch-mates stay on the fast path
+            survivors = []
+            for i, row in zip(good_idx, good_rows):
+                try:
+                    survivors.append((i, row, assemble([row])))
+                except (ValueError, TypeError) as e:
+                    replies[i] = Reply({"error": f"bad request: {e}"},
+                                       status=400)
+            if not survivors:
+                return replies
+            good_idx = [i for i, _, _ in survivors]
             try:
-                data = assemble(good_rows)
-            except (ValueError, TypeError) as batch_err:
-                # a parseable body with a BAD VALUE (ragged vector, wrong
-                # type/width) breaks the columnar assembly — find the
-                # offender(s) per row, 400 them, and run the model ONCE on
-                # the survivors so batch-mates stay on the fast path
-                survivors = []
-                for i, row in zip(good_idx, good_rows):
-                    try:
-                        assemble([row])
-                        survivors.append((i, row))
-                    except (ValueError, TypeError) as e:
-                        replies[i] = Reply({"error": f"bad request: {e}"},
-                                           status=400)
-                if not survivors:
-                    return replies
-                good_idx = [i for i, _ in survivors]
-                data = assemble([row for _, row in survivors])
-                del batch_err
-            # model execution: exceptions here are SERVER faults and
-            # propagate to the worker's replay/502 machinery untouched.
-            # The span joins the ambient request trace the serving worker
-            # activated (no-op when the batch is unsampled).
-            with get_tracer().span("serving.plan.run",
-                                   rows=len(good_idx)):
-                vals = np.asarray(run(data))
-            prefix, suffix = self._prefix, self._suffix
-            if vals.ndim == 1 and vals.dtype.kind == "f":
-                # scalar-float fast path: Python float repr IS shortest
-                # round-trip JSON for finite values — skips json.dumps per
-                # row; non-finite falls back to json.dumps (NaN/Infinity,
-                # the same non-strict tokens the legacy path emitted)
-                for i, v in zip(good_idx, vals.tolist()):
-                    enc = (repr(v) if math.isfinite(v)
-                           else json.dumps(v)).encode()
-                    replies[i] = Reply(prefix + enc + suffix,
-                                       content_type="application/json")
-            else:
-                for i, v in zip(good_idx, vals):
-                    replies[i] = self._encode(v)
+                data = assemble([row for _, row, _ in survivors])
+            except (ValueError, TypeError):
+                # rows valid ALONE but mutually incompatible (e.g. two
+                # different vector widths, each plausible by itself):
+                # score each row in its own batch — batch-mates stay
+                # answered and nothing rides the replay machinery for
+                # what is client-shaped data
+                for i, _, single in survivors:
+                    self._run_rows([i], single, run, replies)
+                return replies
+        self._run_rows(good_idx, data, run, replies)
         return replies
+
+    def _run_rows(self, good_idx: list, data, run, replies: list) -> None:
+        """Execute the plan and encode one reply per row. Exceptions from
+        `run` are SERVER faults and propagate to the worker's replay/502
+        machinery untouched. The span joins the ambient request trace the
+        serving worker activated (no-op when the batch is unsampled)."""
+        with get_tracer().span(tnames.SERVING_PLAN_RUN_SPAN,
+                               rows=len(good_idx)):
+            vals = np.asarray(run(data))
+        prefix, suffix = self._prefix, self._suffix
+        if vals.ndim == 1 and vals.dtype.kind == "f":
+            # scalar-float fast path: Python float repr IS shortest
+            # round-trip JSON for finite values — skips json.dumps per
+            # row; non-finite falls back to json.dumps (NaN/Infinity,
+            # the same non-strict tokens the legacy path emitted)
+            for i, v in zip(good_idx, vals.tolist()):
+                enc = (repr(v) if math.isfinite(v)
+                       else json.dumps(v)).encode()
+                replies[i] = Reply(prefix + enc + suffix,
+                                   content_type="application/json")
+        else:
+            for i, v in zip(good_idx, vals):
+                replies[i] = self._encode(v)
 
     def _encode(self, v) -> Reply:
         return Reply(

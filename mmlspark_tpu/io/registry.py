@@ -27,6 +27,7 @@ from typing import NamedTuple, Optional
 
 from ..reliability.policy import RetryPolicy
 from ..telemetry.spans import get_tracer
+from ..telemetry import names as tnames
 from .serving import _ThreadingServer
 
 
@@ -165,7 +166,7 @@ def report_server_to_registry(registry_address: str, name: str, host: str,
     policy = retry_policy if retry_policy is not None else RetryPolicy(
         max_attempts=32, backoff=0.05, backoff_factor=2.0, max_backoff=1.0,
         jitter=0.25, deadline=timeout,
-        metric_name="registry.report_retries")
+        metric_name=tnames.REGISTRY_REPORT_RETRIES)
     info = ServiceInfo(name=name, host=host, port=port,
                        process_id=process_id, num_partitions=num_partitions)
     data = json.dumps(info._asdict()).encode()

@@ -45,6 +45,7 @@ from ..core import Table
 from ..reliability.faults import FaultInjector, InjectedCrash
 from ..reliability.metrics import reliability_metrics
 from ..telemetry.spans import TRACE_HEADER, get_tracer
+from ..telemetry import names as tnames
 
 
 class Reply(NamedTuple):
@@ -699,7 +700,7 @@ class ServingServer:
         if ctx is None and tracer.sample_rate <= 0.0:
             return None
         return tracer.start_span(
-            "serving.request", parent=ctx,
+            tnames.SERVING_REQUEST_SPAN, parent=ctx,
             trace_id=None if ctx is not None else req.id,
             span_id=req.id, attrs={"path": req.path})
 
@@ -708,7 +709,7 @@ class ServingServer:
         req.span = self._start_request_span(req)
         if self._draining:
             # drain: in-flight work finishes, NEW work is refused
-            reliability_metrics.inc("serving.shed_requests")
+            reliability_metrics.inc(tnames.SERVING_SHED_REQUESTS)
             req.respond(503, b'{"error": "server draining"}')
             return
         pid = next(self._rr) % self.num_partitions
@@ -716,7 +717,7 @@ class ServingServer:
             # load shedding: a queue past the bound means every enqueued
             # request is already doomed to time out — shed NOW with 503 so
             # clients back off instead of piling onto a 504 cliff
-            reliability_metrics.inc("serving.shed_requests")
+            reliability_metrics.inc(tnames.SERVING_SHED_REQUESTS)
             req.respond(503, b'{"error": "overloaded"}')
             return
         req.t_enqueue = time.perf_counter()
@@ -724,7 +725,7 @@ class ServingServer:
             self._routing[req.id] = req
         q = self._queues[pid]
         q.put(req)
-        reliability_metrics.set_gauge("serving.queue_depth", q.qsize())
+        reliability_metrics.set_gauge(tnames.SERVING_QUEUE_DEPTH, q.qsize())
 
     # -- source API (per-partition readers) ---------------------------------
     def get_batch(self, pid: int, max_rows: int = 64,
@@ -753,7 +754,7 @@ class ServingServer:
             now = time.perf_counter()
             # one registry lookup per batch (NOT per request); the handle is
             # never cached across calls so tests' reset() stays effective
-            hist = reliability_metrics.histogram("serving.request.queue")
+            hist = reliability_metrics.histogram(tnames.SERVING_REQUEST_QUEUE)
             for r in batch:
                 hist.observe_ms((now - r.t_enqueue) * 1000.0)
         with self._lock:
@@ -856,7 +857,7 @@ class ServingQuery:
                 if th.is_alive() or self._stop.is_set():
                     continue
                 self._restarts += 1
-                reliability_metrics.inc("serving.worker_restarts")
+                reliability_metrics.inc(tnames.SERVING_WORKER_RESTARTS)
                 fresh = threading.Thread(target=self._work, args=(pid,),
                                          daemon=True)
                 self._threads[pid] = fresh
@@ -897,7 +898,7 @@ class ServingQuery:
                 # raise: an intentional death shouldn't spray a traceback)
                 self._recoveries += 1
                 if batch:
-                    reliability_metrics.inc("serving.replayed_epochs")
+                    reliability_metrics.inc(tnames.SERVING_REPLAYED_EPOCHS)
                 return
             except Exception as e:  # noqa: BLE001 - worker survives task errors
                 if len(self._errors) < 1000:
@@ -905,7 +906,7 @@ class ServingQuery:
                 self._recoveries += 1
                 replays += 1
                 if batch:
-                    reliability_metrics.inc("serving.replayed_epochs")
+                    reliability_metrics.inc(tnames.SERVING_REPLAYED_EPOCHS)
                 if batch and replays > self.MAX_REPLAYS:
                     # poison batch: isolate the poison ROW instead of
                     # failing everyone — retry each request individually so
@@ -941,7 +942,7 @@ class ServingQuery:
         live = [r for r in batch if not r._event.is_set()]
         if not live:
             return
-        reliability_metrics.set_gauge("serving.batch.occupancy",
+        reliability_metrics.set_gauge(tnames.SERVING_BATCH_OCCUPANCY,
                                       len(live) / max(self.max_batch, 1))
         bodies = [r.body for r in live]
         # trace context rides into the transform: nested spans (the
@@ -964,7 +965,7 @@ class ServingQuery:
             dur_ms = (t1 - t0) * 1000.0
             for r in live:
                 if r.span is not None:
-                    tracer.record("serving.partition.transform",
+                    tracer.record(tnames.SERVING_PARTITION_TRANSFORM_SPAN,
                                   parent=r.span, duration_ms=dur_ms,
                                   attrs={"partition": pid, "epoch": epoch,
                                          "batch": len(live)})
@@ -974,11 +975,11 @@ class ServingQuery:
         # stage latencies: transform/reply are per-BATCH (every request in
         # the batch experienced them); e2e is per request from ingress
         # enqueue to routed response
-        reliability_metrics.observe_ms("serving.request.transform",
+        reliability_metrics.observe_ms(tnames.SERVING_REQUEST_TRANSFORM,
                                        (t1 - t0) * 1000.0)
-        reliability_metrics.observe_ms("serving.request.reply",
+        reliability_metrics.observe_ms(tnames.SERVING_REQUEST_REPLY,
                                        (t2 - t1) * 1000.0)
-        hist = reliability_metrics.histogram("serving.request.e2e")
+        hist = reliability_metrics.histogram(tnames.SERVING_REQUEST_E2E)
         for r in live:
             hist.observe_ms((t2 - r.t_enqueue) * 1000.0)
 
@@ -1058,7 +1059,7 @@ def drain_on_signal(servers=(), queries=(), registries=(),
         signals = (_signal.SIGTERM, _signal.SIGINT)
 
     def _handler(signum=_signal.SIGTERM, frame=None):
-        reliability_metrics.inc("serving.signal_drains")
+        reliability_metrics.inc(tnames.SERVING_SIGNAL_DRAINS)
         # order matters: servers drain FIRST (workers must still be alive
         # to answer the in-flight requests), then queries, then registries
         for s in servers:

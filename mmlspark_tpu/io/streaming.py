@@ -53,7 +53,12 @@ class FileStreamSource:
         self._sizes: dict = {}        # binary: path -> size at last poll
         self._names: Optional[list] = None   # csv schema (first header)
         self._pending = None          # (epoch, table, next_state) uncommitted
+        # _lock guards the tiny state handoff (_pending/_epoch/offsets);
+        # _io_lock serializes the glob+read discovery pass SEPARATELY, so
+        # commit() and the pending-check never wait behind a slow disk scan
+        # (graftlint lock-blocking-call: file reads used to run under _lock)
         self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
         # files whose discovery failed DETERMINISTICALLY (schema drift, or
         # read errors persisting past _READ_RETRIES polls): path -> error.
         # Quarantined so one bad file can't halt the stream; transient
@@ -157,16 +162,31 @@ class FileStreamSource:
 
     # -- source API (ServingServer contract) ---------------------------------
     def get_batch(self):
-        """(epoch, Table|None). Uncommitted epochs replay the cached batch."""
+        """(epoch, Table|None). Uncommitted epochs replay the cached batch.
+
+        Discovery (glob + whole-file reads) runs under `_io_lock` only:
+        while a discoverer holds it, `_pending` is None so `commit()` is a
+        no-op and the offset/seen state cannot change underneath the scan
+        — concurrent `get_batch` callers serialize on the I/O, not on the
+        state lock."""
         with self._lock:
             if self._pending is not None:
                 return self._pending[0], self._pending[1]
-            table, nxt = (self._discover_binary() if self.mode == "binary"
-                          else self._discover_csv())
-            if table is None:
-                return self._epoch, None
-            self._pending = (self._epoch, table, nxt)
-            return self._epoch, table
+        with self._io_lock:
+            with self._lock:
+                if self._pending is not None:   # another caller landed one
+                    return self._pending[0], self._pending[1]
+            # intentional I/O under the DEDICATED discovery lock — that
+            # serialization is this lock's entire job
+            if self.mode == "binary":
+                table, nxt = self._discover_binary()  # graftlint: disable=lock-blocking-call
+            else:
+                table, nxt = self._discover_csv()  # graftlint: disable=lock-blocking-call
+            with self._lock:
+                if table is None:
+                    return self._epoch, None
+                self._pending = (self._epoch, table, nxt)
+                return self._epoch, table
 
     def commit(self, epoch: int) -> None:
         """Advance the durable position; only then does new data flow."""

@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from .transformer import init_transformer, transformer_apply
+from ...telemetry.names import LM_RUN_STREAM_SPAN
 
 
 def _param_shardings(params: dict, mesh):
@@ -282,7 +283,7 @@ class ShardedLMTrainer:
                 for tok_dev in pf:
                     losses.append(one_batch(tok_dev))
             get_tracer().record(
-                "lm.run_stream",
+                LM_RUN_STREAM_SPAN,
                 duration_ms=(_time.perf_counter() - _run_t0) * 1000.0,
                 attrs={"steps": len(losses), "supervised": False})
             return losses
@@ -324,7 +325,7 @@ class ShardedLMTrainer:
         try:
             out = sup.run(step_fn, len(batches), seek=seek, resume=resume)
             get_tracer().record(
-                "lm.run_stream",
+                LM_RUN_STREAM_SPAN,
                 duration_ms=(_time.perf_counter() - _run_t0) * 1000.0,
                 attrs={"steps": len(out), "supervised": True,
                        "resumed_step": sup.resumed_step or 0})

@@ -25,6 +25,7 @@ import numpy as np
 from ...ops import binning
 from ...reliability.metrics import reliability_metrics
 from ...telemetry.spans import get_tracer
+from ...telemetry import names as tnames
 from ...utils import tracing
 from . import objectives as obj_mod
 from . import trainer
@@ -382,7 +383,7 @@ def fit_booster(x: np.ndarray, y: np.ndarray, params: BoostParams,
     error — per-iteration/per-chunk children attach through the activated
     context inside."""
     _tel = get_tracer()
-    span = _tel.start_span("gbdt.fit", attrs={
+    span = _tel.start_span(tnames.GBDT_FIT_SPAN, attrs={
         "rows": int(x.shape[0]), "features": int(x.shape[1]),
         "iterations": int(params.num_iterations),
         "objective": params.objective, "boosting": params.boosting})
@@ -450,7 +451,7 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
 
     def _iter_mark(it_idx, t0):
         if _tel.current() is not None:
-            _tel.record("gbdt.iteration",
+            _tel.record(tnames.GBDT_ITERATION_SPAN,
                         duration_ms=(time.perf_counter() - t0) * 1000.0,
                         attrs={"iteration": int(it_idx) + iter_offset})
     multiclass = p.objective == "multiclass"
@@ -474,7 +475,7 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
             mapper, d_bins = prebinned
         d_bins = put(d_bins)
     else:
-        with tracing.wall_clock("data.fit_bins",
+        with tracing.wall_clock(tnames.DATA_FIT_BINS,
                                 sink=reliability_metrics.observe):
             mapper = binning.fit_bins(
                 x, max_bin=p.max_bin, seed=p.seed,
@@ -705,7 +706,7 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
             if _tel.current() is not None:
                 # the fused scan has no host-visible per-iteration boundary;
                 # the chunk IS the granularity device work surfaces at
-                _tel.record("gbdt.chunk",
+                _tel.record(tnames.GBDT_CHUNK_SPAN,
                             duration_ms=(time.perf_counter() - _chunk_t0)
                             * 1000.0,
                             attrs={"first_iteration": it + iter_offset,

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -31,6 +30,8 @@ import numpy as np
 from ..reliability.faults import FaultInjector
 from ..reliability.metrics import reliability_metrics
 from ..reliability.policy import RetryPolicy
+from ..telemetry.spans import wall_now
+from ..telemetry import names as tnames
 
 
 class ClusterInfo(NamedTuple):
@@ -98,7 +99,7 @@ def initialize_cluster(coordinator_address: Optional[str] = None,
             retry_policy.call(
                 _join, retry_on=(RuntimeError,),
                 on_retry=lambda att, e: reliability_metrics.inc(
-                    "cluster.rendezvous_retries"))
+                    tnames.CLUSTER_RENDEZVOUS_RETRIES))
         else:
             _join()
     return ClusterInfo(process_id=jax.process_index(),
@@ -207,8 +208,8 @@ class Heartbeat:
         self.resume_epoch: Optional[int] = (
             None if prior is None else int(prior.get("epoch", 0)))
         if prior is not None:
-            self._metrics.set_gauge("cluster.resume_epoch", self.resume_epoch)
-            self._metrics.inc("cluster.rejoins")
+            self._metrics.set_gauge(tnames.CLUSTER_RESUME_EPOCH, self.resume_epoch)
+            self._metrics.inc(tnames.CLUSTER_REJOINS)
 
     @property
     def rejoining(self) -> bool:
@@ -222,8 +223,13 @@ class Heartbeat:
             self._faults.perturb("cluster.heartbeat")
         tmp = f"{self.path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
+            # wall_now(): beats from THIS process advance monotonically, so
+            # a same-process rejoin (the primary reader) never sees its own
+            # prior beat jump forward/backward across an NTP step. Cross-
+            # process comparisons stay approximate — each process anchors
+            # its own wall clock at start, like any wall timestamp
             json.dump({"process_id": self.process_id, "epoch": int(epoch),
-                       "time": time.time()}, f)
+                       "time": wall_now()}, f)
         os.replace(tmp, self.path)
 
     def read(self, process_id: Optional[int] = None) -> Optional[dict]:

@@ -39,6 +39,7 @@ import threading
 import time
 import zlib
 from typing import Callable, NamedTuple, Optional
+from ..telemetry.names import FAULT_INJECTED_EVENT
 
 FAULTS_ENV = "MMLSPARK_TPU_FAULTS"
 
@@ -109,7 +110,7 @@ class FaultInjector:
         fault schedule's behavior."""
         try:
             from ..telemetry.spans import get_tracer
-            get_tracer().event("fault.injected", site=fault.site,
+            get_tracer().event(FAULT_INJECTED_EVENT, site=fault.site,
                                index=fault.index, kind=fault.kind)
         except Exception:  # noqa: BLE001
             pass

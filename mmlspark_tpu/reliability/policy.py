@@ -30,6 +30,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from .metrics import reliability_metrics
+from ..telemetry.names import breaker_trips
 
 T = TypeVar("T")
 
@@ -327,7 +328,7 @@ class CircuitBreaker:
         self._opened_at = self._clock()
         self._probing = False
         self._outcomes.clear()
-        self._metrics.inc(f"{self.name}.trips")
+        self._metrics.inc(breaker_trips(self.name))
 
     def call(self, fn: Callable[[], T]) -> T:
         """Gate fn() through the breaker: CircuitOpenError without calling

@@ -49,6 +49,7 @@ import time
 from typing import Callable, Optional, Sequence
 
 from ..telemetry.spans import get_tracer
+from ..telemetry import names as tnames
 from ..utils.checkpoint import CheckpointManager
 from .faults import FaultInjector, InjectedFault
 from .metrics import reliability_metrics
@@ -113,16 +114,16 @@ class AsyncCheckpointWriter:
                 raise RuntimeError("AsyncCheckpointWriter is closed")
             while len(self._q) >= self.depth:
                 self._q.popleft()
-                self.metrics.inc("checkpoint.write.coalesced")
+                self.metrics.inc(tnames.CHECKPOINT_WRITE_COALESCED)
             self._q.append((int(step), payload, bool(prune_newer)))
-            self.metrics.set_gauge("checkpoint.write.pending", len(self._q))
+            self.metrics.set_gauge(tnames.CHECKPOINT_WRITE_PENDING, len(self._q))
             if self._thread is None:
                 self._thread = threading.Thread(target=self._loop,
                                                 daemon=True,
                                                 name="ckpt-writer")
                 self._thread.start()
             self._cond.notify_all()
-        self.metrics.observe_ms("checkpoint.submit",
+        self.metrics.observe_ms(tnames.CHECKPOINT_SUBMIT,
                                 (time.perf_counter() - t0) * 1000.0)
 
     def pending(self) -> int:
@@ -172,7 +173,7 @@ class AsyncCheckpointWriter:
                     return
                 step, payload, prune = self._q.popleft()
                 self._busy = True
-                self.metrics.set_gauge("checkpoint.write.pending",
+                self.metrics.set_gauge(tnames.CHECKPOINT_WRITE_PENDING,
                                        len(self._q))
             try:
                 self._write(step, payload, prune, absorb=True)
@@ -187,7 +188,8 @@ class AsyncCheckpointWriter:
         # lifecycle span (sync finals + async writer-thread writes alike):
         # chaos/telemetry runs see every write attempt with its outcome
         span = get_tracer().start_span(
-            "checkpoint.write", attrs={"step": step, "sync": not absorb})
+            tnames.CHECKPOINT_WRITE_SPAN,
+            attrs={"step": step, "sync": not absorb})
         try:
             if self.faults is not None:
                 self.faults.perturb("train.ckpt.write")
@@ -197,13 +199,13 @@ class AsyncCheckpointWriter:
         except Exception as e:  # noqa: BLE001 - async writes must not kill training
             if span is not None:
                 span.finish(ok=False, error=type(e).__name__)
-            self.metrics.inc("checkpoint.write.errors")
+            self.metrics.inc(tnames.CHECKPOINT_WRITE_ERRORS)
             logger.warning("checkpoint write for step %d failed (%s: %s)",
                            step, type(e).__name__, e)
             if not absorb:
                 raise
         finally:
-            self.metrics.observe_ms("checkpoint.write",
+            self.metrics.observe_ms(tnames.CHECKPOINT_WRITE,
                                     (time.perf_counter() - t0) * 1000.0)
 
 
@@ -259,7 +261,7 @@ class TrainingSupervisor:
             directory, max_to_keep=max_to_keep)
         self.retry_policy = retry_policy if retry_policy is not None else \
             RetryPolicy(max_attempts=3, backoff=0.05, max_backoff=1.0,
-                        metric_name="train.step_retries")
+                        metric_name=tnames.TRAIN_STEP_RETRIES)
         self.writer = AsyncCheckpointWriter(self.manager, depth=queue_depth,
                                             metrics=self.metrics,
                                             faults=self.faults)
@@ -295,9 +297,9 @@ class TrainingSupervisor:
         self.restore_fn({k: v for k, v in payload.items()
                          if k not in _RESERVED})
         self.resumed_step = step
-        self.metrics.inc("train.resumes")
-        self.metrics.set_gauge("train.resume_step", step)
-        get_tracer().event("train.resume", step=step)
+        self.metrics.inc(tnames.TRAIN_RESUMES)
+        self.metrics.set_gauge(tnames.TRAIN_RESUME_STEP, step)
+        get_tracer().event(tnames.TRAIN_RESUME_EVENT, step=step)
         logger.info("resumed training from checkpoint step %d", step)
         return step
 
@@ -332,7 +334,7 @@ class TrainingSupervisor:
                     # step span: covers the fault site too, so an injected
                     # step failure records error=<type> on ITS step before
                     # the restart machinery engages
-                    with get_tracer().span("train.step", step=step):
+                    with get_tracer().span(tnames.TRAIN_STEP_SPAN, step=step):
                         if self.faults is not None:
                             self.faults.perturb(f"train.step{step}")
                         out = self._call_step(step_fn, step)
@@ -387,7 +389,7 @@ class TrainingSupervisor:
             # timeout watchdog suits steps that hang in host I/O and die
             # with the process (a truly wedged collective, a dead NFS
             # mount), not steps that may eventually complete.
-            self.metrics.inc("train.step_timeouts")
+            self.metrics.inc(tnames.TRAIN_STEP_TIMEOUTS)
             raise StepTimeout(
                 f"step {step} exceeded its {self.step_timeout}s budget")
         if "err" in box:
@@ -406,8 +408,8 @@ class TrainingSupervisor:
             raise err
         assert self._last is not None
         last_step, payload, results = self._last
-        self.metrics.inc("train.step_restarts")
-        get_tracer().event("train.restart", step=last_step,
+        self.metrics.inc(tnames.TRAIN_STEP_RESTARTS)
+        get_tracer().event(tnames.TRAIN_RESTART_EVENT, step=last_step,
                            error=type(err).__name__)
         logger.warning("training step failed (%s: %s); restarting from "
                        "snapshot step %d", type(err).__name__, err, last_step)
@@ -446,7 +448,7 @@ class TrainingSupervisor:
                 except (TypeError, ValueError):
                     # non-JSON results: resumable, but history restarts
                     self._results_jsonable = False
-        self.metrics.observe_ms("checkpoint.snapshot",
+        self.metrics.observe_ms(tnames.CHECKPOINT_SNAPSHOT,
                                 (time.perf_counter() - t0) * 1000.0)
         return payload
 
@@ -462,7 +464,7 @@ class TrainingSupervisor:
             else:
                 self.heartbeat.beat(step)
         except Exception as e:  # noqa: BLE001 - observability must not kill
-            self.metrics.inc("cluster.heartbeat_errors")
+            self.metrics.inc(tnames.CLUSTER_HEARTBEAT_ERRORS)
             logger.warning("heartbeat update failed (%s: %s)",
                            type(e).__name__, e)
 
@@ -487,7 +489,7 @@ class TrainingSupervisor:
             # preemption into a crash. Best effort: try the direct write
             # anyway (its step dir is distinct from the in-flight one);
             # failing that, the periodic checkpoints still allow resume.
-            self.metrics.inc("checkpoint.finalize_errors")
+            self.metrics.inc(tnames.CHECKPOINT_FINALIZE_ERRORS)
             logger.warning("final preemption checkpoint write failed "
                            "(%s: %s); resuming will use the last periodic "
                            "checkpoint", type(e).__name__, e)
@@ -496,8 +498,8 @@ class TrainingSupervisor:
             except Exception:  # noqa: BLE001
                 pass
         if preempted:
-            self.metrics.inc("train.preempted")
-            get_tracer().event("train.preempted", step=step,
+            self.metrics.inc(tnames.TRAIN_PREEMPTED)
+            get_tracer().event(tnames.TRAIN_PREEMPTED_EVENT, step=step,
                                signum=self._preempt)
             self._beat(step)
         else:
@@ -510,7 +512,7 @@ class TrainingSupervisor:
 
         def handler(signum, frame):
             self._preempt = signum
-            self.metrics.inc("train.preempt_signals")
+            self.metrics.inc(tnames.TRAIN_PREEMPT_SIGNALS)
 
         old = {}
         for sig in (_signal.SIGTERM, _signal.SIGINT):

@@ -11,6 +11,7 @@ from typing import Optional
 
 from ..core import Estimator, Model, Param, Table, Transformer
 from ..core.pipeline import PipelineStage
+from ..telemetry.names import stage_span
 
 _logger = logging.getLogger("mmlspark_tpu.timer")
 
@@ -37,7 +38,7 @@ def _observe_stage(stage, action: str, seconds: float) -> bool:
     must NOT silently drop a timing the user asked for, so the caller
     falls back to the console print."""
     from ..telemetry.spans import get_tracer
-    return get_tracer().observe(f"stage.{type(stage).__name__}.{action}",
+    return get_tracer().observe(stage_span(type(stage).__name__, action),
                                 seconds) is not None
 
 

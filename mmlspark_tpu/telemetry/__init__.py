@@ -27,7 +27,8 @@ compare per site (`BENCH_MODE=telemetry` pins the off/1%/full A/B).
 """
 from .spans import (CAPACITY_ENV, REQUEST_ID_HEADER, SAMPLE_ENV, Span,
                     SpanContext, TRACE_HEADER, Tracer, configure, get_tracer,
-                    head_sampled, new_id, parse_trace_header, read_jsonl)
+                    head_sampled, new_id, parse_trace_header, read_jsonl,
+                    wall_now)
 
 # exposition re-exports are LAZY: spans.py is the stdlib-only layer every
 # subsystem imports (`from ..telemetry.spans import get_tracer`), and that
@@ -48,6 +49,7 @@ def __getattr__(name):
 
 __all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
            "head_sampled", "new_id", "parse_trace_header", "read_jsonl",
+           "wall_now",
            "TRACE_HEADER", "REQUEST_ID_HEADER", "SAMPLE_ENV", "CAPACITY_ENV",
            "render_prometheus", "metrics_http_response", "merge_states",
            "state_snapshot", "scrape_cluster", "ClusterSnapshot",

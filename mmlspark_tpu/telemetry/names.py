@@ -1,0 +1,255 @@
+"""Canonical metric / span / event / fault-site names — ONE place.
+
+Every counter, gauge, histogram, wall-clock timing label, span, event,
+and fault-injection site the framework records is declared here, with a
+one-line description. `graftlint` (mmlspark_tpu/analysis) enforces the
+contract in both directions: package call sites must use names declared
+here (as the constants below — a raw literal that is not canonical is
+flagged, with typo suggestions), and every declared name must appear in
+the docs/observability.md name table.
+
+Conventions:
+
+- Names are dotted, `subsystem.signal[.detail]`, lowercase.
+- Patterned names carry `{placeholder}` segments (e.g.
+  ``train.step{step}``); the helpers below render them. Keep the
+  placeholder text meaningful — it is the documentation.
+- FAULT SITES ARE THE EXCEPTION to the use-the-constant rule: the
+  literal at a `perturb("...")`/`fire("...")` call site is what the
+  analyzer cross-references against chaos-test schedules
+  (`fault-site-unknown` / `fault-site-untested`), so fire sites keep
+  their strings inline and this registry validates them.
+- This module is pure stdlib data: importable from every layer (and
+  executed standalone by the analyzer) with zero dependency cost.
+
+Metric-family names (counters/gauges/histograms/timings) share the
+`MetricsRegistry.snapshot()` namespace — never reuse one name across two
+of those kinds (`metric-kind-collision` enforces it).
+"""
+from __future__ import annotations
+
+# --------------------------------------------------------------- counters
+SERVING_SHED_REQUESTS = "serving.shed_requests"
+SERVING_WORKER_RESTARTS = "serving.worker_restarts"
+SERVING_REPLAYED_EPOCHS = "serving.replayed_epochs"
+SERVING_SIGNAL_DRAINS = "serving.signal_drains"
+SERVING_PLAN_HITS = "serving.plan.hits"
+SERVING_PLAN_MISSES = "serving.plan.misses"
+CHECKPOINT_SAVE_COUNT = "checkpoint.save.count"
+CHECKPOINT_SAVE_BYTES = "checkpoint.save.bytes"
+CHECKPOINT_CORRUPT_SKIPPED = "checkpoint.corrupt_skipped"
+CHECKPOINT_DIGEST_MISMATCH = "checkpoint.digest_mismatch"
+CHECKPOINT_WRITE_COALESCED = "checkpoint.write.coalesced"
+CHECKPOINT_WRITE_ERRORS = "checkpoint.write.errors"
+CHECKPOINT_FINALIZE_ERRORS = "checkpoint.finalize_errors"
+TRAIN_RESUMES = "train.resumes"
+TRAIN_STEP_RESTARTS = "train.step_restarts"
+TRAIN_STEP_TIMEOUTS = "train.step_timeouts"
+TRAIN_STEP_RETRIES = "train.step_retries"
+TRAIN_PREEMPTED = "train.preempted"
+TRAIN_PREEMPT_SIGNALS = "train.preempt_signals"
+CLUSTER_REJOINS = "cluster.rejoins"
+CLUSTER_HEARTBEAT_ERRORS = "cluster.heartbeat_errors"
+CLUSTER_RENDEZVOUS_RETRIES = "cluster.rendezvous_retries"
+REGISTRY_REPORT_RETRIES = "registry.report_retries"
+HTTP_RETRIES = "http.retries"
+RETRY_RETRIES = "retry.retries"
+DATA_WORKER_FAILURES = "data.worker_failures"
+DATA_PREFETCH_ITEMS = "data.prefetch.items"
+DATA_PREFETCH_STALLS = "data.prefetch.stalls"
+DATA_PREFETCH_FULL = "data.prefetch.full"
+
+COUNTERS = {
+    SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
+                           "load shedding)",
+    SERVING_WORKER_RESTARTS: "partition worker threads restarted by the "
+                             "watchdog",
+    SERVING_REPLAYED_EPOCHS: "uncommitted epochs replayed after a worker "
+                             "death/failure",
+    SERVING_SIGNAL_DRAINS: "SIGTERM/SIGINT graceful drains taken",
+    SERVING_PLAN_HITS: "compiled-plan cache hits (fingerprint, bucket)",
+    SERVING_PLAN_MISSES: "compiled-plan cache misses (one compile each)",
+    CHECKPOINT_SAVE_COUNT: "checkpoints written",
+    CHECKPOINT_SAVE_BYTES: "bytes written across checkpoint payloads",
+    CHECKPOINT_CORRUPT_SKIPPED: "truncated/unreadable checkpoint steps "
+                                "skipped on restore",
+    CHECKPOINT_DIGEST_MISMATCH: "checkpoint steps failing SHA-256 verify "
+                                "on restore",
+    CHECKPOINT_WRITE_COALESCED: "async snapshots dropped latest-wins "
+                                "under backpressure",
+    CHECKPOINT_WRITE_ERRORS: "async checkpoint writes that failed "
+                             "(absorbed)",
+    CHECKPOINT_FINALIZE_ERRORS: "final-checkpoint failures during "
+                                "supervisor finalize",
+    TRAIN_RESUMES: "supervisor runs resumed from a checkpoint",
+    TRAIN_STEP_RESTARTS: "step-loop restarts from the in-memory snapshot",
+    TRAIN_STEP_TIMEOUTS: "steps killed by the step_timeout watchdog",
+    TRAIN_STEP_RETRIES: "step retry attempts under the restart "
+                        "RetryPolicy",
+    TRAIN_PREEMPTED: "runs ended by preemption (final checkpoint taken)",
+    TRAIN_PREEMPT_SIGNALS: "SIGTERM/SIGINT deliveries observed mid-run",
+    CLUSTER_REJOINS: "processes that found their own prior heartbeat at "
+                     "startup",
+    CLUSTER_HEARTBEAT_ERRORS: "heartbeat writes that failed (counted, "
+                              "never fatal)",
+    CLUSTER_RENDEZVOUS_RETRIES: "jax.distributed rendezvous connection "
+                                "retries",
+    REGISTRY_REPORT_RETRIES: "worker->registry registration retries",
+    HTTP_RETRIES: "HTTP handler retry attempts (io/http.py)",
+    RETRY_RETRIES: "generic utils.retry attempts",
+    DATA_WORKER_FAILURES: "ingest pool chunk failures (first failing "
+                          "chunk raises)",
+    DATA_PREFETCH_ITEMS: "batches fed through DevicePrefetcher",
+    DATA_PREFETCH_STALLS: "consumer arrived at an empty prefetch queue",
+    DATA_PREFETCH_FULL: "feeder found the prefetch queue full (device is "
+                        "the bottleneck)",
+    "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
+                             "(process/thread)",
+    "{breaker}.trips": "circuit-breaker trips, one counter per breaker "
+                       "name",
+}
+
+# ----------------------------------------------------------------- gauges
+SERVING_QUEUE_DEPTH = "serving.queue_depth"
+SERVING_BATCH_OCCUPANCY = "serving.batch.occupancy"
+CHECKPOINT_WRITE_PENDING = "checkpoint.write.pending"
+TRAIN_RESUME_STEP = "train.resume_step"
+CLUSTER_RESUME_EPOCH = "cluster.resume_epoch"
+
+GAUGES = {
+    SERVING_QUEUE_DEPTH: "partition queue depth at last enqueue",
+    SERVING_BATCH_OCCUPANCY: "live-rows / max_batch of the last "
+                             "dispatched batch",
+    CHECKPOINT_WRITE_PENDING: "async checkpoint snapshots queued",
+    TRAIN_RESUME_STEP: "step the supervisor resumed from",
+    CLUSTER_RESUME_EPOCH: "epoch found in this process's prior heartbeat",
+}
+
+# ------------------------------------------------------------- histograms
+SERVING_REQUEST_QUEUE = "serving.request.queue"
+SERVING_REQUEST_TRANSFORM = "serving.request.transform"
+SERVING_REQUEST_REPLY = "serving.request.reply"
+SERVING_REQUEST_E2E = "serving.request.e2e"
+CHECKPOINT_SUBMIT = "checkpoint.submit"
+CHECKPOINT_SNAPSHOT = "checkpoint.snapshot"
+CHECKPOINT_WRITE = "checkpoint.write"
+
+HISTOGRAMS = {
+    SERVING_REQUEST_QUEUE: "ingress enqueue -> worker drain, per request "
+                           "(ms)",
+    SERVING_REQUEST_TRANSFORM: "transform duration per batch (ms)",
+    SERVING_REQUEST_REPLY: "reply routing duration per batch (ms)",
+    SERVING_REQUEST_E2E: "enqueue -> response routed, per request (ms)",
+    CHECKPOINT_SUBMIT: "step-thread time to hand a snapshot to the "
+                       "async writer (ms)",
+    CHECKPOINT_SNAPSHOT: "snapshot_fn duration on the step thread (ms)",
+    CHECKPOINT_WRITE: "checkpoint write duration, sync and async (ms)",
+}
+
+# ------------------------------------------------- wall-clock timing labels
+DATA_PREFETCH_PUT = "data.prefetch.put"
+DATA_BIN_CHUNK = "data.bin_chunk"
+DATA_FIT_BINS = "data.fit_bins"
+DATA_APPLY_BINS = "data.apply_bins"
+DATA_STAGE_BINNED = "data.stage_binned"
+DATA_TABLE_TRANSFORM = "data.table_transform"
+
+TIMINGS = {
+    DATA_PREFETCH_PUT: "feeder time spent in device_put",
+    DATA_BIN_CHUNK: "per-chunk binning transform wall clock",
+    DATA_FIT_BINS: "quantile bin fit wall clock",
+    DATA_APPLY_BINS: "parallel bin application wall clock",
+    DATA_STAGE_BINNED: "stage_binned end-to-end wall clock",
+    DATA_TABLE_TRANSFORM: "ParallelTransform table pass wall clock",
+    "data.pool.map[{mode}]": "WorkerPool.map_rows wall clock per backend",
+}
+
+# ------------------------------------------------------------------ spans
+SERVING_REQUEST_SPAN = "serving.request"
+SERVING_PARTITION_TRANSFORM_SPAN = "serving.partition.transform"
+SERVING_PLAN_RUN_SPAN = "serving.plan.run"
+TRAIN_STEP_SPAN = "train.step"
+CHECKPOINT_WRITE_SPAN = "checkpoint.write"
+DATA_PREFETCH_SPAN = "data.prefetch"
+GBDT_FIT_SPAN = "gbdt.fit"
+GBDT_ITERATION_SPAN = "gbdt.iteration"
+GBDT_CHUNK_SPAN = "gbdt.chunk"
+LM_RUN_STREAM_SPAN = "lm.run_stream"
+DEVICE_PROFILE_SPAN = "device.profile"
+
+SPANS = {
+    SERVING_REQUEST_SPAN: "ingress root span per request (== request id)",
+    SERVING_PARTITION_TRANSFORM_SPAN: "worker-hop child span per sampled "
+                                      "request",
+    SERVING_PLAN_RUN_SPAN: "compiled-plan execution per batch",
+    TRAIN_STEP_SPAN: "one supervised training step (covers the fault "
+                     "site)",
+    CHECKPOINT_WRITE_SPAN: "one checkpoint write attempt (sync/async, "
+                           "ok/error)",
+    DATA_PREFETCH_SPAN: "DevicePrefetcher lifecycle (depth, items, "
+                        "stalls)",
+    GBDT_FIT_SPAN: "whole fit_booster call",
+    GBDT_ITERATION_SPAN: "one boosting iteration (host loop)",
+    GBDT_CHUNK_SPAN: "one fused boosting chunk (scan path)",
+    LM_RUN_STREAM_SPAN: "ShardedLMTrainer.run_stream lifecycle",
+    DEVICE_PROFILE_SPAN: "utils.tracing.trace device-profile capture",
+    "stage.{stage}.{action}": "Timer-wrapped stage fit/transform "
+                              "(telemetry=True)",
+}
+
+# ----------------------------------------------------------------- events
+FAULT_INJECTED_EVENT = "fault.injected"
+TRAIN_RESUME_EVENT = "train.resume"
+TRAIN_RESTART_EVENT = "train.restart"
+TRAIN_PREEMPTED_EVENT = "train.preempted"
+
+EVENTS = {
+    FAULT_INJECTED_EVENT: "one FaultInjector firing (site, index, kind)",
+    TRAIN_RESUME_EVENT: "supervisor resumed from a checkpoint",
+    TRAIN_RESTART_EVENT: "supervisor restarted the step loop from the "
+                         "in-memory snapshot",
+    TRAIN_PREEMPTED_EVENT: "supervisor took the preemption exit",
+    "registry.{action}": "registry HTTP hops (register/unregister) under "
+                         "the caller's propagated trace",
+}
+
+# ------------------------------------------------------------- fault sites
+# Fire sites keep their literals inline (see module docstring); this is
+# the canonical list the analyzer validates both code and chaos tests
+# against. Patterned sites carry the per-call index in the name.
+FAULT_SITES = {
+    "serving.ingress": "selector-transport ingress, fired per parsed "
+                       "request (kind `reset` drops the socket)",
+    "serving.worker": "partition worker between batch read and commit",
+    "train.step{step}": "supervisor step k, fired before the step fn",
+    "train.ckpt.write": "checkpoint write path (sync and async)",
+    "train.ckpt.read": "checkpoint restore path",
+    "cluster.heartbeat": "Heartbeat.beat() before the atomic write",
+    "data.worker.chunk{index}": "ingest pool, fired before chunk i's "
+                                "transform",
+    "fuzz.http": "corrupt_bytes stream for the malformed-HTTP fuzz "
+                 "corpus",
+    "checkpoint": "corrupt_file default site (checkpoint corruption "
+                  "tests)",
+}
+
+
+# ------------------------------------------------- patterned-name helpers
+def data_pool_maps(mode: str) -> str:
+    """data.pool.{mode}_maps — per-backend WorkerPool map counter."""
+    return f"data.pool.{mode}_maps"
+
+
+def data_pool_map_timing(mode: str) -> str:
+    """data.pool.map[{mode}] — per-backend map wall-clock label."""
+    return f"data.pool.map[{mode}]"
+
+
+def breaker_trips(breaker: str) -> str:
+    """{breaker}.trips — per-breaker trip counter."""
+    return f"{breaker}.trips"
+
+
+def stage_span(stage: str, action: str) -> str:
+    """stage.{stage}.{action} — Timer span label."""
+    return f"stage.{stage}.{action}"

@@ -77,6 +77,23 @@ _current: contextvars.ContextVar = contextvars.ContextVar(
     "mmlspark_tpu_trace_ctx", default=None)
 
 
+# One wall-clock anchor per process, captured once at import: epoch-valued
+# timestamps are derived as anchor + perf_counter(), so they ADVANCE
+# MONOTONICALLY — an NTP step mid-run cannot reorder span starts against
+# their seq numbers, make a heartbeat look fresh/stale by hours, or
+# interleave usage-log timestamps backwards. (graftlint's `wall-clock`
+# rule points raw time.time() call sites here.)
+_WALL_ANCHOR = time.time() - time.perf_counter()  # graftlint: disable=wall-clock
+
+
+def wall_now() -> float:
+    """Epoch-valued timestamp that advances monotonically (never steps
+    backward with NTP): the process-start wall clock plus the monotonic
+    perf_counter. Use for timestamps that get COMPARED or ordered —
+    span starts, heartbeats, event logs."""
+    return _WALL_ANCHOR + time.perf_counter()
+
+
 def new_id() -> str:
     """16-hex span/trace id (uuid4-derived: unique without coordination)."""
     return uuid.uuid4().hex[:16]
@@ -121,8 +138,10 @@ class Span:
         self.trace_id = trace_id
         self.span_id = span_id
         self.parent_id = parent_id
-        self.start_s = time.time()
         self._t0 = time.perf_counter()
+        # derived from the same monotonic reading as duration: span starts
+        # order consistently with seq even across an NTP step
+        self.start_s = _WALL_ANCHOR + self._t0
         self.attrs = dict(attrs) if attrs else {}
         self.duration_ms = 0.0
         self.kind = "span"

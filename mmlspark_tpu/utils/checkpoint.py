@@ -23,6 +23,7 @@ import zipfile
 import numpy as np
 
 from ..reliability.metrics import reliability_metrics
+from ..telemetry import names as tnames
 
 logger = logging.getLogger(__name__)
 
@@ -152,8 +153,8 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
-        reliability_metrics.inc("checkpoint.save.count")
-        reliability_metrics.inc("checkpoint.save.bytes", nbytes)
+        reliability_metrics.inc(tnames.CHECKPOINT_SAVE_COUNT)
+        reliability_metrics.inc(tnames.CHECKPOINT_SAVE_BYTES, nbytes)
         if prune_newer:
             for newer in [s for s in self.all_steps() if s > step]:
                 shutil.rmtree(self._step_dir(newer), ignore_errors=True)
@@ -186,7 +187,7 @@ class CheckpointManager:
                 return (out, s) if with_step else out
             except _CORRUPT_ERRORS as e:
                 last_err = e
-                reliability_metrics.inc("checkpoint.corrupt_skipped")
+                reliability_metrics.inc(tnames.CHECKPOINT_CORRUPT_SKIPPED)
                 logger.warning(
                     "checkpoint step %d under %r unreadable (%s: %s); "
                     "falling back to next-newest step", s, self.directory,
@@ -218,7 +219,7 @@ class CheckpointManager:
                        if name == "meta"
                        else _file_sha256(os.path.join(d, name)))
                 if got != want:
-                    reliability_metrics.inc("checkpoint.digest_mismatch")
+                    reliability_metrics.inc(tnames.CHECKPOINT_DIGEST_MISMATCH)
                     raise ValueError(
                         f"checkpoint step {step}: {name} sha256 mismatch "
                         f"(recorded {want[:12]}…, found {got[:12]}…)")

@@ -18,6 +18,7 @@ import concurrent.futures
 from typing import Callable, Optional, TypeVar
 
 from ..reliability.policy import RetryPolicy
+from ..telemetry.names import RETRY_RETRIES
 
 T = TypeVar("T")
 
@@ -39,7 +40,7 @@ def retry_with_timeout(fn: Callable[[], T], times: int = 3,
         policy = RetryPolicy(max_attempts=times, backoff=backoff,
                              backoff_factor=backoff_factor, jitter=jitter,
                              deadline=deadline, retry_on=retry_on,
-                             metric_name="retry.retries")
+                             metric_name=RETRY_RETRIES)
     last: BaseException = RuntimeError("retry_with_timeout: no attempts ran")
     # one shared executor torn down with shutdown(wait=False): a hung
     # attempt's thread is abandoned rather than joined — `with

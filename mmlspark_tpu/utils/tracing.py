@@ -30,10 +30,12 @@ def trace(log_dir: str, create_perfetto_link: bool = False):
             model.fit(table)
     """
     import jax
+    from ..telemetry.names import DEVICE_PROFILE_SPAN
     from ..telemetry.spans import get_tracer
     os.makedirs(log_dir, exist_ok=True)
     tracer = get_tracer()
-    span = tracer.start_span("device.profile", attrs={"log_dir": log_dir})
+    span = tracer.start_span(DEVICE_PROFILE_SPAN,
+                             attrs={"log_dir": log_dir})
     jax.profiler.start_trace(log_dir,
                              create_perfetto_link=create_perfetto_link)
     try:

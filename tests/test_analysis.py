@@ -1,0 +1,743 @@
+"""graftlint (ISSUE 6 tentpole): the tier-1 gate plus per-rule fixtures.
+
+Two layers:
+
+- THE GATE: `test_repo_is_clean_under_strict` runs the full analyzer over
+  the shipped tree with the committed baseline — a new lock/trace/
+  determinism/name violation anywhere in the package fails tier-1.
+- FIXTURES: each of the six checkers is proven to (a) flag a seeded
+  violation and (b) honor a `# graftlint: disable=<rule>` comment, so
+  the gate can never go green because a rule silently stopped firing.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mmlspark_tpu.analysis import (Analyzer, BASELINE_FILENAME, Baseline,
+                                   default_rules, run)
+from mmlspark_tpu.analysis.checkers.determinism import (LegacyRandomRule,
+                                                        SetIterationRule,
+                                                        WallClockRule)
+from mmlspark_tpu.analysis.checkers.faultsync import (FaultSiteUnknownRule,
+                                                      FaultSiteUntestedRule)
+from mmlspark_tpu.analysis.checkers.hygiene import (ShmNoUnlinkRule,
+                                                    ThreadNotJoinedRule)
+from mmlspark_tpu.analysis.checkers.locks import (LockBlockingCallRule,
+                                                  LockOrderCycleRule)
+from mmlspark_tpu.analysis.checkers.markers import PytestMarkerRule
+from mmlspark_tpu.analysis.checkers.names import (MetricKindCollisionRule,
+                                                  MetricNameRule,
+                                                  MetricNameUndocumentedRule)
+from mmlspark_tpu.analysis.checkers.tracing import (TraceMutableClosureRule,
+                                                    TraceNumpyCallRule,
+                                                    TracePythonBranchRule)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# minimal canonical registry for name-rule fixtures
+_NAMES_PY = '''
+SERVING_SHED = "serving.shed_requests"
+COUNTERS = {SERVING_SHED: "requests shed"}
+GAUGES = {"serving.queue_depth": "queue depth"}
+HISTOGRAMS = {"serving.request.e2e": "end to end"}
+TIMINGS = {}
+SPANS = {"serving.request": "root span"}
+EVENTS = {}
+FAULT_SITES = {"serving.worker": "worker site",
+               "train.step{step}": "per-step site"}
+'''
+
+
+def _lint(root, files, rules):
+    """Write `files` (rel -> source) under root, run `rules`, return
+    active findings."""
+    for rel, src in files.items():
+        path = os.path.join(str(root), rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(src))
+    tops = sorted({rel.split("/", 1)[0] for rel in files
+                   if rel.endswith(".py")})
+    report = Analyzer(rules, root=str(root)).run(tops)
+    assert not report.skipped, report.skipped
+    return report.active
+
+
+# ------------------------------------------------------------------ the gate
+def test_repo_is_clean_under_strict():
+    """`python -m mmlspark_tpu.analysis --strict mmlspark_tpu tests`
+    equivalent, in-process: zero unbaselined findings on the shipped
+    tree. A violation anywhere fails HERE, in tier-1."""
+    report = run(["mmlspark_tpu", "tests"], root=_REPO)
+    assert not report.skipped, f"unparseable files: {report.skipped}"
+    assert not report.active, "\n" + report.render_text()
+
+
+def test_cli_strict_exits_zero_on_shipped_tree():
+    """The acceptance command itself, end to end through the console
+    entry point."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "mmlspark_tpu.analysis", "--strict",
+         "mmlspark_tpu", "tests"],
+        cwd=_REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout, proc.stdout
+
+
+# ------------------------------------------------------- 1. lock discipline
+_BAD_LOCK = """
+    import threading
+    import time
+    _lock = threading.Lock()
+
+    def f():
+        with _lock:
+            time.sleep(0.5){disable}
+"""
+
+
+def test_lock_blocking_call_flagged_and_suppressed(tmp_path):
+    bad = {"pkg/mod.py": _BAD_LOCK.format(disable="")}
+    found = _lint(tmp_path / "a", bad, [LockBlockingCallRule()])
+    assert [f.rule for f in found] == ["lock-blocking-call"]
+    assert "time.sleep" in found[0].message
+    ok = {"pkg/mod.py": _BAD_LOCK.format(
+        disable="  # graftlint: disable=lock-blocking-call")}
+    assert _lint(tmp_path / "b", ok, [LockBlockingCallRule()]) == []
+
+
+def test_lock_blocking_call_sees_one_level_of_calls(tmp_path):
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _scan(self):
+            with open("/etc/hostname") as f:
+                return f.read()
+
+        def get(self):
+            with self._lock:
+                return self._scan()
+    """
+    found = _lint(tmp_path, {"pkg/mod.py": src}, [LockBlockingCallRule()])
+    assert len(found) == 1 and "self._scan()" in found[0].message
+
+
+def test_lock_blocking_call_resolution_is_class_scoped(tmp_path):
+    # two false-positive classes the one-level resolver must not hit:
+    # (a) a DIFFERENT class's same-named method blocks — B._flush only
+    # clears a list, A._flush's open() must not poison it; (b) a method
+    # that merely DEFINES a blocking closure (body runs later, lock not
+    # held) is not itself blocking
+    src = """
+    import threading
+
+    class A:
+        def _flush(self):
+            with open("/tmp/x") as f:
+                return f.read()
+
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._buf = []
+
+        def _flush(self):
+            self._buf.clear()
+
+        def push(self, x):
+            with self._lock:
+                self._flush()
+
+        def make_loop(self):
+            def _loop():
+                with open("/tmp/y") as f:
+                    return f.read()
+            return _loop
+
+        def go(self):
+            with self._lock:
+                return self.make_loop()
+    """
+    assert _lint(tmp_path, {"pkg/mod.py": src},
+                 [LockBlockingCallRule()]) == []
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    src = """
+    import threading
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
+
+    def one():
+        with a_lock:
+            with b_lock:
+                pass
+
+    def two():
+        with b_lock:
+            with a_lock:
+                pass
+    """
+    found = _lint(tmp_path, {"pkg/mod.py": src}, [LockOrderCycleRule()])
+    assert len(found) == 1 and "cycle" in found[0].message
+    # consistent ordering everywhere: no cycle
+    src_ok = src.replace("with b_lock:\n            with a_lock:",
+                         "with a_lock:\n            with b_lock:")
+    assert _lint(tmp_path / "ok", {"pkg/mod.py": src_ok},
+                 [LockOrderCycleRule()]) == []
+
+
+def test_lock_order_cycle_multi_item_with(tmp_path):
+    # `with a, b:` acquires left-to-right — it must contribute the same
+    # ordering edge as the nested form, or the one-line idiom silently
+    # escapes the deadlock gate
+    src = """
+    import threading
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
+
+    def one():
+        with a_lock, b_lock:
+            pass
+
+    def two():
+        with b_lock:
+            with a_lock:
+                pass
+    """
+    found = _lint(tmp_path, {"pkg/mod.py": src}, [LockOrderCycleRule()])
+    assert len(found) == 1 and "cycle" in found[0].message
+
+
+def test_condition_wait_on_held_lock_is_protocol_not_finding(tmp_path):
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cond = threading.Condition()
+
+        def drain(self, timeout):
+            with self._cond:
+                self._cond.wait(timeout)
+    """
+    assert _lint(tmp_path, {"pkg/mod.py": src},
+                 [LockBlockingCallRule()]) == []
+
+
+# -------------------------------------------------------- 2. trace hazards
+def test_trace_python_branch_flagged_and_static_exempt(tmp_path):
+    src = """
+    import functools
+    import jax
+
+    @jax.jit
+    def bad(x):
+        if x > 0:{disable}
+            return x
+        return -x
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def ok_static(x, n):
+        if n > 2:
+            return x * 2.0
+        return x
+
+    @jax.jit
+    def ok_shape(x):
+        if x.shape[0] > 4:
+            return x
+        return x * 2.0
+
+    @jax.jit
+    def ok_none(x, mask=None):
+        if mask is None:
+            return x
+        return x * mask
+    """
+    found = _lint(tmp_path / "a", {"pkg/mod.py": src.format(disable="")},
+                  [TracePythonBranchRule()])
+    assert [f.rule for f in found] == ["trace-python-branch"]
+    assert "`x`" in found[0].message
+    ok = src.format(disable="  # graftlint: disable=trace-python-branch")
+    assert _lint(tmp_path / "b", {"pkg/mod.py": ok},
+                 [TracePythonBranchRule()]) == []
+
+
+def test_trace_numpy_call_flagged(tmp_path):
+    src = """
+    import jax
+    import numpy as np
+
+    def host(y):
+        return np.abs(y)          # not traced: fine
+
+    @jax.jit
+    def bad(x):
+        return np.abs(x){disable}
+
+    def build():
+        def inner(x):
+            return np.sum(x)
+        return jax.jit(inner)     # call-site wrapping also detected
+    """
+    found = _lint(tmp_path / "a", {"pkg/mod.py": src.format(disable="")},
+                  [TraceNumpyCallRule()])
+    assert sorted(f.line for f in found) and len(found) == 2
+    ok = src.format(disable="  # graftlint: disable=trace-numpy-call")
+    found2 = _lint(tmp_path / "b", {"pkg/mod.py": ok},
+                   [TraceNumpyCallRule()])
+    assert len(found2) == 1   # only the undisabled inner() one remains
+
+
+def test_trace_mutable_closure_flagged(tmp_path):
+    src = """
+    import jax
+
+    def make_step():
+        history = []
+
+        @jax.jit
+        def step(x):
+            history.append(1){disable}
+            return x * 2.0
+        return step
+    """
+    found = _lint(tmp_path / "a", {"pkg/mod.py": src.format(disable="")},
+                  [TraceMutableClosureRule()])
+    assert [f.rule for f in found] == ["trace-mutable-closure"]
+    assert "history" in found[0].message
+    ok = src.format(disable="  # graftlint: disable=trace-mutable-closure")
+    assert _lint(tmp_path / "b", {"pkg/mod.py": ok},
+                 [TraceMutableClosureRule()]) == []
+
+
+# --------------------------------------------------------- 3. determinism
+def test_determinism_rules_flag_and_suppress(tmp_path):
+    src = """
+    import time
+    import numpy as np
+
+    def stamp():
+        return time.time(){d1}
+
+    def draw():
+        return np.random.rand(3){d2}
+
+    def payload(keys):
+        return [k for k in set(keys)]{d3}
+
+    def ok():
+        rng = np.random.default_rng(7)
+        t0 = time.monotonic()
+        return rng.normal(), time.perf_counter() - t0
+
+    def ok_sorted(keys):
+        return [k for k in sorted(set(keys))]
+    """
+    rules = [WallClockRule(), LegacyRandomRule(), SetIterationRule()]
+    found = _lint(tmp_path / "a",
+                  {"pkg/mod.py": src.format(d1="", d2="", d3="")}, rules)
+    assert sorted(f.rule for f in found) == [
+        "legacy-random", "set-iteration", "wall-clock"]
+    ok = src.format(d1="  # graftlint: disable=wall-clock",
+                    d2="  # graftlint: disable=legacy-random",
+                    d3="  # graftlint: disable=set-iteration")
+    assert _lint(tmp_path / "b", {"pkg/mod.py": ok}, rules) == []
+
+
+def test_set_literal_iteration_flagged(tmp_path):
+    src = """
+    def payload():
+        out = []
+        for k in {"a", "b", "c"}:
+            out.append(k)
+        return out
+    """
+    found = _lint(tmp_path, {"pkg/mod.py": src}, [SetIterationRule()])
+    assert [f.rule for f in found] == ["set-iteration"]
+
+
+def test_wall_clock_flags_from_import_and_module_alias(tmp_path):
+    files = {"pkg/mod.py": """
+    from time import time as now
+    import time as _t
+
+    def a():
+        return now()
+
+    def b():
+        return _t.time()
+    """}
+    found = _lint(tmp_path, files, [WallClockRule()])
+    assert [f.rule for f in found] == ["wall-clock", "wall-clock"]
+
+
+# ------------------------------------------------------ 4. name registry
+def _names_files(bad_call):
+    return {
+        "pkg/telemetry/names.py": _NAMES_PY,
+        "pkg/mod.py": f"""
+    from .telemetry import names
+    from ..reliability.metrics import reliability_metrics
+
+
+    def record():
+        {bad_call}
+    """,
+        "docs/observability.md": "`serving.shed_requests`"
+                                 " `serving.queue_depth`"
+                                 " `serving.request.e2e` `serving.request`"
+                                 " `serving.worker` `train.step{step}`\n",
+    }
+
+
+def test_metric_name_unknown_flagged_and_suppressed(tmp_path):
+    files = _names_files(
+        'reliability_metrics.inc("serving.never_registered")')
+    found = _lint(tmp_path / "a", files, [MetricNameRule()])
+    assert [f.rule for f in found] == ["metric-name-unknown"]
+    files = _names_files(
+        'reliability_metrics.inc("serving.never_registered")'
+        '  # graftlint: disable=metric-name-unknown')
+    assert _lint(tmp_path / "b", files, [MetricNameRule()]) == []
+
+
+def test_metric_name_typo_suggests_canonical(tmp_path):
+    files = _names_files('reliability_metrics.inc("serving.shed_request")')
+    found = _lint(tmp_path, files, [MetricNameRule()])
+    assert [f.rule for f in found] == ["metric-name-typo"]
+    assert "serving.shed_requests" in found[0].message
+
+
+def test_metric_kind_collision_flagged(tmp_path):
+    files = _names_files(
+        'reliability_metrics.inc("serving.request.e2e")')  # histogram name
+    found = _lint(tmp_path, files, [MetricKindCollisionRule()])
+    assert [f.rule for f in found] == ["metric-kind-collision"]
+    assert "histogram" in found[0].message
+
+
+def test_metric_kind_collision_crosses_families(tmp_path):
+    # a SPAN-registered name used as a counter is the same misuse class
+    # but lives outside the counter/gauge/histogram/timing family — it
+    # must not slip between this rule and metric-name-unknown
+    files = _names_files(
+        'reliability_metrics.inc("serving.request")')  # span name
+    found = _lint(tmp_path, files, [MetricKindCollisionRule()])
+    assert [f.rule for f in found] == ["metric-kind-collision"]
+    assert "span" in found[0].message
+    # ...and MetricNameRule stays silent on it (single report, one id)
+    assert _lint(tmp_path / "n", files, [MetricNameRule()]) == []
+
+
+def test_metric_name_undocumented_flagged(tmp_path):
+    files = _names_files("pass")
+    files["docs/observability.md"] = "only `serving.shed_requests` here\n"
+    found = _lint(tmp_path, files, [MetricNameUndocumentedRule()])
+    missing = {f.message.split("'")[1] for f in found}
+    assert "serving.queue_depth" in missing
+    assert "serving.shed_requests" not in missing
+
+
+def test_metric_name_stale_doc_row_flagged(tmp_path):
+    # reverse sync: a table row under "## Name registry" whose name left
+    # the registry is stale and must be reported; backticked identifiers
+    # OUTSIDE the registry section (hooks tables, prose) are not names
+    files = _names_files("pass")
+    files["docs/observability.md"] = (
+        "| `core.Pipeline` | hooks table, not a name |\n"
+        "## Name registry\n"
+        "| `serving.shed_requests` | requests shed |\n"
+        "| `serving.queue_depth` | queue depth |\n"
+        "| `serving.request.e2e` | end to end |\n"
+        "| `serving.request` | root span |\n"
+        "| `serving.worker` | worker site |\n"
+        "| `train.step{step}` | per-step site |\n"
+        "| `serving.renamed_away` | stale row |\n"
+        "## Later section\n"
+        "| `io.ServingServer` | backticked identifier, not a name |\n")
+    found = _lint(tmp_path, files, [MetricNameUndocumentedRule()])
+    assert [f.rule for f in found] == ["metric-name-undocumented"]
+    assert "serving.renamed_away" in found[0].message
+    assert "stale" in found[0].message
+
+
+# ---------------------------------------------------- 5. fault-site sync
+def test_fault_site_unknown_flagged_and_suppressed(tmp_path):
+    pkg = """
+    def work(faults):
+        faults.perturb("serving.worker")
+    """
+    tst = """
+    RULES = [{"site": "serving.wroker", "kind": "crash", "at": [0]}]DISABLE
+    """
+    found = _lint(tmp_path / "a",
+                  {"pkg/mod.py": pkg,
+                   "tests/test_mod.py": tst.replace("DISABLE", "")},
+                  [FaultSiteUnknownRule()])
+    assert [f.rule for f in found] == ["fault-site-unknown"]
+    ok_tst = tst.replace(
+        "DISABLE", "  # graftlint: disable=fault-site-unknown")
+    assert _lint(tmp_path / "b",
+                 {"pkg/mod.py": pkg, "tests/test_mod.py": ok_tst},
+                 [FaultSiteUnknownRule()]) == []
+
+
+def test_fault_site_kwonly_signature_default_harvested(tmp_path):
+    # `def beat(self, *, site="cluster.heartbeat")` declares a fire site
+    # just as a positional default does — a test scheduling it must not
+    # be flagged unknown, and the site must count as tested
+    files = {
+        "pkg/mod.py": """
+    def beat(faults, *, site="cluster.heartbeat"):
+        faults.perturb(site)
+    """,
+        "tests/test_mod.py": """
+    RULES = [{"site": "cluster.heartbeat", "kind": "error", "at": [0]}]
+    """,
+    }
+    assert _lint(tmp_path, files,
+                 [FaultSiteUnknownRule(), FaultSiteUntestedRule()]) == []
+
+
+def test_fault_site_untested_and_pattern_matching(tmp_path):
+    files = {
+        "pkg/mod.py": """
+    def work(faults, k):
+        faults.perturb(f"train.step{k}")
+        faults.perturb("ingest.flush")
+    """,
+        "tests/test_mod.py": """
+    RULES = [{"site": "train.step3", "kind": "error", "at": [0]}]
+    """,
+    }
+    found = _lint(tmp_path, files, [FaultSiteUntestedRule()])
+    # the f-string pattern matches the concrete test ref; ingest.flush
+    # has no test and is reported
+    assert [f.rule for f in found] == ["fault-site-untested"]
+    assert "ingest.flush" in found[0].message
+
+
+# -------------------------------------------------- 6. resource hygiene
+def test_thread_not_joined_flagged_daemon_and_join_pass(tmp_path):
+    src = """
+    import threading
+
+    def leak():
+        t = threading.Thread(target=print){d}
+        t.start()
+
+    def ok_daemon():
+        t = threading.Thread(target=print, daemon=True)
+        t.start()
+
+    class W:
+        def start(self):
+            self._thread = threading.Thread(target=print)
+            self._thread.start()
+
+        def stop(self):
+            self._thread.join(timeout=5)
+    """
+    found = _lint(tmp_path / "a", {"pkg/mod.py": src.format(d="")},
+                  [ThreadNotJoinedRule()])
+    assert [f.rule for f in found] == ["thread-not-joined"]
+    ok = src.format(d="  # graftlint: disable=thread-not-joined")
+    assert _lint(tmp_path / "b", {"pkg/mod.py": ok},
+                 [ThreadNotJoinedRule()]) == []
+
+
+def test_thread_not_joined_sees_import_aliases(tmp_path):
+    src = """
+    import threading as t
+    from threading import Thread as T
+
+    def leak_a(fn):
+        th = t.Thread(target=fn)
+        th.start()
+        return th
+
+    def leak_b(fn):
+        th = T(target=fn)
+        th.start()
+        return th
+    """
+    found = _lint(tmp_path, {"pkg/mod.py": src}, [ThreadNotJoinedRule()])
+    assert [f.rule for f in found] == ["thread-not-joined"] * 2
+
+
+def test_shm_unlink_rules(tmp_path):
+    src = """
+    from multiprocessing import shared_memory
+
+    def leak():
+        s = shared_memory.SharedMemory(create=True, size=64){d}
+        return s.name
+
+    def ok():
+        s = shared_memory.SharedMemory(create=True, size=64)
+        try:
+            return bytes(s.buf[:1])
+        finally:
+            s.close()
+            s.unlink()
+
+    def ok_loop():
+        a = shared_memory.SharedMemory(create=True, size=64)
+        b = shared_memory.SharedMemory(create=True, size=64)
+        try:
+            return a.name, b.name
+        finally:
+            for shm in (a, b):
+                shm.close()
+                shm.unlink()
+    """
+    found = _lint(tmp_path / "a", {"pkg/mod.py": src.format(d="")},
+                  [ShmNoUnlinkRule()])
+    assert [f.rule for f in found] == ["shm-no-unlink"]
+    assert found[0].severity == "error"
+    ok = src.format(d="  # graftlint: disable=shm-no-unlink")
+    assert _lint(tmp_path / "b", {"pkg/mod.py": ok},
+                 [ShmNoUnlinkRule()]) == []
+
+
+# ------------------------------------------------------ pytest markers
+def test_pytest_marker_undeclared_flagged(tmp_path):
+    files = {
+        "tests/test_mod.py": """
+    import pytest
+
+    @pytest.mark.slowish{d}
+    def test_x():
+        pass
+
+    @pytest.mark.parametrize("v", [1])
+    def test_y(v):
+        pass
+    """,
+        "pyproject.toml": '[tool.pytest.ini_options]\n'
+                          'markers = ["slow: declared"]\n',
+    }
+    bad = dict(files)
+    bad["tests/test_mod.py"] = files["tests/test_mod.py"].format(d="")
+    found = _lint(tmp_path / "a", bad, [PytestMarkerRule()])
+    assert [f.rule for f in found] == ["pytest-marker-undeclared"]
+    assert "slowish" in found[0].message
+    ok = dict(files)
+    ok["tests/test_mod.py"] = files["tests/test_mod.py"].format(
+        d="  # graftlint: disable=pytest-marker-undeclared")
+    assert _lint(tmp_path / "b", ok, [PytestMarkerRule()]) == []
+
+
+def test_repo_markers_all_declared():
+    """The live satellite check: every marker used under tests/ is in
+    pyproject (chaos/slow filtering can't silently rot)."""
+    report = Analyzer([PytestMarkerRule()], root=_REPO).run(["tests"])
+    assert report.active == [], report.render_text()
+
+
+# --------------------------------------------- baseline + file suppression
+def test_baseline_covers_known_findings_only(tmp_path):
+    files = {"pkg/mod.py": """
+    import time
+
+    def a():
+        return time.time()
+    """}
+    root = tmp_path
+    found = _lint(root, files, [WallClockRule()])
+    assert len(found) == 1
+    bl = Baseline.from_findings(found)
+    bl_path = os.path.join(str(root), BASELINE_FILENAME)
+    bl.save(bl_path)
+    # same tree: fully baselined
+    report = Analyzer([WallClockRule()], root=str(root)).run(
+        ["pkg"], baseline=Baseline.load(bl_path))
+    assert report.active == [] and len(report.findings) == 1
+    # a NEW violation is not covered — and survives line drift of the old
+    with open(os.path.join(str(root), "pkg", "mod.py"), "a") as f:
+        f.write("\n\ndef b():\n    return time.time()\n")
+    report = Analyzer([WallClockRule()], root=str(root)).run(
+        ["pkg"], baseline=Baseline.load(bl_path))
+    assert len(report.active) == 1 and len(report.findings) == 2
+
+
+def test_file_level_disable(tmp_path):
+    files = {"pkg/mod.py": """
+    # graftlint: disable-file=wall-clock
+    import time
+
+    def a():
+        return time.time()
+
+    def b():
+        return time.time()
+    """}
+    assert _lint(tmp_path, files, [WallClockRule()]) == []
+
+
+def test_cli_json_format_and_write_baseline(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "mod.py").write_text(
+        "import time\n\n\ndef a():\n    return time.time()\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-m", "mmlspark_tpu.analysis", "--root",
+         str(tmp_path), "--format", "json", "--select", "wall-clock",
+         "pkg"],
+        cwd=_REPO, capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 1, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    assert data["active"] == 1
+    assert data["findings"][0]["rule"] == "wall-clock"
+    # --write-baseline with --select would overwrite the other rules'
+    # baseline entries wholesale: refused with a usage error
+    refused = subprocess.run(
+        [sys.executable, "-m", "mmlspark_tpu.analysis", "--root",
+         str(tmp_path), "--select", "wall-clock", "--write-baseline",
+         "pkg"],
+        cwd=_REPO, capture_output=True, text=True, timeout=300, env=env)
+    assert refused.returncode == 2, refused.stdout + refused.stderr
+    # full write-baseline, then the same invocation gates clean
+    wb = subprocess.run(
+        [sys.executable, "-m", "mmlspark_tpu.analysis", "--root",
+         str(tmp_path), "--write-baseline", "pkg"],
+        cwd=_REPO, capture_output=True, text=True, timeout=300, env=env)
+    assert wb.returncode == 0, wb.stdout + wb.stderr
+    assert os.path.exists(str(tmp_path / BASELINE_FILENAME))
+    out2 = subprocess.run(
+        [sys.executable, "-m", "mmlspark_tpu.analysis", "--root",
+         str(tmp_path), "--strict", "pkg"],
+        cwd=_REPO, capture_output=True, text=True, timeout=300, env=env)
+    assert out2.returncode == 0, out2.stdout + out2.stderr
+
+
+def test_cli_missing_path_is_usage_error(tmp_path, capsys):
+    # a typo'd path walks zero files — it must be a loud usage error,
+    # not a green "0 findings" gate
+    from mmlspark_tpu.analysis.cli import main
+    assert main(["--root", str(tmp_path), "no_such_dir"]) == 2
+    assert "not found" in capsys.readouterr().err
+
+
+def test_default_rules_cover_the_six_checkers():
+    names = {r.name for r in default_rules()}
+    for expected in ("lock-blocking-call", "lock-order-cycle",
+                     "trace-python-branch", "trace-numpy-call",
+                     "trace-mutable-closure", "wall-clock",
+                     "legacy-random", "set-iteration",
+                     "metric-name-unknown", "metric-kind-collision",
+                     "metric-name-undocumented", "fault-site-unknown",
+                     "fault-site-untested", "thread-not-joined",
+                     "shm-no-unlink", "pytest-marker-undeclared"):
+        assert expected in names

@@ -71,6 +71,69 @@ def test_plan_cache_counters_in_metrics():
     assert snap.get("serving.plan.hits") == 3, snap
 
 
+def test_plan_cache_miss_stampede_single_flight():
+    """Two workers missing the same (fingerprint, bucket) CONCURRENTLY
+    must produce exactly ONE compile: the second misser blocks on the
+    builder and receives the same plan object — `serving.plan.misses`
+    stays pinned at 1 however many partitions race a cold cache."""
+    reliability_metrics.reset("serving.")
+    model = _fit_gbdt()
+    transform = compile_serving_transform(model, ["features"])
+    builds = []
+    in_build = threading.Event()
+    release = threading.Event()
+    real_build = transform._build_plan
+
+    def slow_build(bucket):
+        builds.append(bucket)
+        in_build.set()
+        assert release.wait(10), "test orchestration stalled"
+        return real_build(bucket)
+
+    transform._build_plan = slow_build
+    plans = []
+    threads = [threading.Thread(
+        target=lambda: plans.append(transform._plan_for(3)))
+        for _ in range(2)]
+    threads[0].start()
+    assert in_build.wait(10)         # first thread is inside the compile
+    threads[1].start()               # second thread misses the same key
+    time.sleep(0.05)                 # give it time to reach the wait path
+    release.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert len(plans) == 2
+    assert plans[0] is plans[1]      # both got THE plan, not copies
+    assert builds == [4]             # exactly one compile (bucket 4)
+    stats = transform.stats()
+    assert stats["misses"] == 1 and stats["hits"] == 1, stats
+    assert reliability_metrics.get("serving.plan.misses") == 1
+    assert reliability_metrics.get("serving.plan.hits") == 1
+
+
+def test_plan_build_failure_not_cached():
+    """A builder that raises must not poison the cache: waiters (and the
+    next caller) retry the build instead of inheriting the failure."""
+    model = _fit_gbdt()
+    transform = compile_serving_transform(model, ["features"])
+    real_build = transform._build_plan
+    calls = []
+
+    def failing_once(bucket):
+        calls.append(bucket)
+        if len(calls) == 1:
+            raise RuntimeError("transient build failure")
+        return real_build(bucket)
+
+    transform._build_plan = failing_once
+    with pytest.raises(RuntimeError, match="transient"):
+        transform._plan_for(3)
+    plan = transform._plan_for(3)    # retried, cached
+    assert plan is transform._plan_for(3)
+    assert len(calls) == 2
+    assert not transform._building   # no leaked single-flight events
+
+
 def test_fingerprint_distinguishes_models():
     a, b = _fit_gbdt(num_iterations=5), _fit_gbdt(num_iterations=6)
     assert pipeline_fingerprint(a) != pipeline_fingerprint(b)
@@ -122,6 +185,28 @@ def test_bad_value_row_isolated_without_replay():
     assert replies[0].status == 200 and replies[3].status == 200
     assert replies[1].status == 400
     assert replies[2].status == 400
+
+
+def test_mutually_ragged_rows_isolated_without_replay():
+    """Rows that are each valid ALONE but mutually incompatible (two
+    different vector widths) must not escape the transform and ride the
+    MAX_REPLAYS machinery: each row scores in its own batch, in the same
+    pass."""
+
+    class WidthAgnostic:
+        """Generic-path model (no _serving_kernel) that accepts any
+        feature width — the shape a real ragged-tolerant stage has."""
+
+        def transform(self, t):
+            x = np.asarray(t["features"])
+            return Table({"prediction": x.sum(axis=1)})
+
+    transform = compile_serving_transform(WidthAgnostic(), ["features"])
+    replies = transform([json.dumps({"features": [1.0, 2.0]}).encode(),
+                         json.dumps({"features": [1.0, 2.0, 3.0]}).encode()])
+    assert [r.status for r in replies] == [200, 200]
+    assert json.loads(replies[0].data)["prediction"] == 3.0
+    assert json.loads(replies[1].data)["prediction"] == 6.0
 
 
 def test_nonfinite_prediction_encodes_like_legacy():

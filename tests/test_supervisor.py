@@ -491,3 +491,40 @@ def test_gbdt_sigterm_subprocess_kill_resume(tmp_path):
     r = np.load(str(tmp_path / "r.npz"))
     assert int(r["n_trees"]) == 12
     assert np.array_equal(f["scores"], r["scores"])
+
+
+def test_ckpt_read_fault_surfaces_then_clean_resume(tmp_path):
+    """An injected fault on the checkpoint READ path (`train.ckpt.read`)
+    surfaces out of run() — a torn restore must never silently train from
+    scratch — and retrying resume on the SAME schedule reads clean and
+    finishes bit-identical to an uninterrupted run."""
+    from mmlspark_tpu.reliability import InjectedFault
+
+    sup, step, state = _toy_supervisor(str(tmp_path / "ref"))
+    ref = sup.run(step, 8)
+    sup.close()
+    x_ref = state["x"].copy()
+
+    # seed on-disk checkpoints by dying at step 5
+    d = str(tmp_path / "ck")
+    inj0 = FaultInjector(seed=7, rules=[
+        {"site": "train.step5", "kind": "crash", "at": [0]}])
+    sup, step, state = _toy_supervisor(
+        d, faults=inj0, retry_policy=RetryPolicy(max_attempts=1))
+    with pytest.raises(Exception, match="injected crash"):
+        sup.run(step, 8)
+    sup.close()
+
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "train.ckpt.read", "kind": "error", "at": [0]}])
+    sup, step, state = _toy_supervisor(d, faults=inj)
+    with pytest.raises(InjectedFault):
+        sup.run(step, 8)
+    # same supervisor, same seeded schedule: the site counter advanced, so
+    # the retry restores cleanly and completes exactly like the reference
+    out = sup.run(step, 8)
+    sup.close()
+    assert sup.resumed_step == 4
+    assert out == ref
+    assert np.array_equal(state["x"], x_ref)
+    assert ("train.ckpt.read", 0, "error") in inj.schedule()
