@@ -514,6 +514,36 @@ def _bench_telemetry():
     assert "serving_request_e2e_seconds_bucket" in expo_text, \
         "GET /metrics under load lost the e2e histogram"
     assert out["off_spans"] == 0 and out["full_spans"] > 0
+
+    # windowed-vs-cumulative A/B (ISSUE 7 satellite): the full run's
+    # traffic is still inside the default 300s shard ring — read the
+    # last-60s percentiles next to the cumulative ones, and time both
+    # snapshot paths. The windowed read merges every live shard
+    # (~shards x buckets int adds), so it is strictly the slower one;
+    # the budget asserts it stays cheap enough to sit on a poller/SLO
+    # hot path (bench-side assert only — never wall clock in tier-1).
+    win = reliability_metrics.window_snapshot(60.0)
+    out["windowed_p50_ms"] = round(
+        win.get("serving.request.e2e.p50", 0.0), 3)
+    out["windowed_p99_ms"] = round(
+        win.get("serving.request.e2e.p99", 0.0), 3)
+    out["windowed_count"] = win.get("serving.request.e2e.count", 0)
+    assert out["windowed_count"] > 0, "full run left no windowed samples"
+    hist = reliability_metrics.histogram("serving.request.e2e")
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        hist.snapshot()
+    t1 = time.perf_counter()
+    for _ in range(reps):
+        hist.window.snapshot(60.0)
+    t2 = time.perf_counter()
+    out["snapshot_cumulative_us"] = round((t1 - t0) / reps * 1e6, 1)
+    out["snapshot_windowed_us"] = round((t2 - t1) / reps * 1e6, 1)
+    out["snapshot_windowed_budget_us"] = 5000.0
+    assert out["snapshot_windowed_us"] <= out["snapshot_windowed_budget_us"], \
+        (f"windowed snapshot cost {out['snapshot_windowed_us']}us — over "
+         f"the {out['snapshot_windowed_budget_us']}us budget")
     out["sampled_overhead_pct"] = round(
         (1.0 - out["sampled_req_per_sec"]
          / max(out["off_req_per_sec"], 1e-9)) * 100.0, 1)

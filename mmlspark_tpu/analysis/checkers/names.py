@@ -46,7 +46,7 @@ _KIND_ATTRS = {
 _METRIC_FAMILY = ("COUNTERS", "GAUGES", "HISTOGRAMS", "TIMINGS")
 # snapshot()-derived keys tests legitimately read back
 _DERIVED_SUFFIXES = {"count", "sum", "mean", "mean_ms", "p50", "p95",
-                     "p99", "seconds"}
+                     "p99", "p999", "max", "seconds"}
 
 
 class Registry:

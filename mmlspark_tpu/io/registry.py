@@ -87,9 +87,12 @@ class _RegistryHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         reg: "ServiceRegistry" = self.server.registry  # type: ignore
         path = self.path.split("?", 1)[0]
-        if path in ("/metrics", "/metrics.json"):
+        if path in ("/metrics", "/metrics.json", "/slo"):
+            # full path rides through so ?window= reaches the handler;
+            # /slo exposes the leader's own objectives (worker verdicts
+            # come from scrape_cluster(slo=True))
             from ..telemetry.exposition import metrics_http_response
-            status, payload, ctype = metrics_http_response(path)
+            status, payload, ctype = metrics_http_response(self.path)
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))

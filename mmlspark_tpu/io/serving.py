@@ -70,7 +70,7 @@ class CachedRequest:
     """One held HTTP exchange (reference: CachedRequest, HTTPSourceV2.scala:519)."""
 
     __slots__ = ("id", "body", "headers", "path", "_event", "_response",
-                 "_on_respond", "t_enqueue", "span")
+                 "_on_respond", "t_enqueue", "span", "slo")
 
     def __init__(self, body: bytes, headers: dict, path: str,
                  on_respond=None):
@@ -83,9 +83,17 @@ class CachedRequest:
         self._on_respond = on_respond   # selector transport wakeup
         self.t_enqueue = 0.0            # stamped by ServingServer._enqueue
         self.span = None                # ingress root span (telemetry)
+        self.slo = False                # counted in serving.request.*
+        #                                 (exposition self-scrapes are not)
 
     def respond(self, status: int, body: bytes,
                 content_type: str = "application/json"):
+        if self.slo and self._response is None and status >= 500:
+            # SLO error-budget numerator: 5xx of any flavor (shed 503,
+            # expiry 504, model 502). First responder wins the count (the
+            # reply/expiry race may call respond twice); the slo flag
+            # gates out exposition exchanges, which must not burn budget
+            reliability_metrics.inc(tnames.SERVING_REQUEST_ERRORS)
         self._response = (status, body, content_type)
         if self.span is not None:
             # root span ends when the response is ROUTED (what the held
@@ -105,6 +113,18 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "mmlspark_tpu-serving/1.0"
 
     def do_POST(self):  # noqa: N802 (stdlib naming)
+        serving: "ServingServer" = self.server.serving  # type: ignore
+        if self.path.split("?", 1)[0] in EXPOSITION_PATHS:
+            # self-scrape exclusion: exposition answered here, never
+            # enqueued — a POSTing poller must not ride the worker path
+            # or inflate serving.request.* counts
+            status, payload, ctype = serving._metrics_response(self.path)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
@@ -118,7 +138,6 @@ class _Handler(BaseHTTPRequestHandler):
             return
         body = self.rfile.read(length)
         cached = CachedRequest(body, dict(self.headers), self.path)
-        serving: "ServingServer" = self.server.serving  # type: ignore
         serving._enqueue(cached)
         resp = cached.wait(serving.reply_timeout)
         if resp is None:
@@ -129,6 +148,11 @@ class _Handler(BaseHTTPRequestHandler):
             # recorded the worker's status instead of the client's
             if cached.span is not None:
                 cached.span.finish(status=504, timeout=True)
+            # route the 504 through respond() so the error-budget count
+            # happens exactly once: a worker reply landing later sees
+            # _response set and skips its own count (a bare counter inc
+            # here double-counted that race)
+            cached.respond(504, b'{"error": "serving timeout"}')
             self.send_response(504)
             # the correlation id must ride EVERY response — the slow
             # request that timed out is exactly the one worth tracing
@@ -148,8 +172,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         serving: "ServingServer" = self.server.serving  # type: ignore
         path = self.path.split("?", 1)[0]
-        if path in ("/metrics", "/metrics.json"):
-            status, payload, ctype = serving._metrics_response(path)
+        if path in EXPOSITION_PATHS:
+            # full path rides through: ?window= selects the shard-merged
+            # recent view instead of cumulative-since-start
+            status, payload, ctype = serving._metrics_response(self.path)
         else:
             status, ctype = 404, "application/json"
             payload = b'{"error": "not found"}'
@@ -174,6 +200,12 @@ class _ThreadingServer(ThreadingHTTPServer):
 _REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
             501: "Not Implemented", 502: "Bad Gateway",
             503: "Service Unavailable", 504: "Gateway Timeout"}
+
+# Exposition endpoints answered at ingress on BOTH transports: never
+# enqueued to partition workers, never shed during drain, and excluded
+# from serving.request.* metrics (a self-scrape must not move the SLO
+# it reports on).
+EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo")
 
 # Ingress bounds: a header block or body beyond these is rejected and the
 # connection closed — the single-threaded loop must never be wedged (or its
@@ -421,16 +453,17 @@ class _SelectorServer:
             body = conn.rbuf[head_end + 4:total]
             conn.rbuf = conn.rbuf[total:]
             bare_path = path.split("?", 1)[0]
-            if bare_path in ("/metrics", "/metrics.json"):
+            if bare_path in EXPOSITION_PATHS:
                 # exposition endpoint: answered on the loop thread, never
                 # enqueued to partition workers (and exempt from ingress
                 # fault injection / drain shedding — the scrape is how you
                 # WATCH a draining server). Rides the normal in-order
-                # response machinery so pipelined predecessors stay intact.
+                # response machinery so pipelined predecessors stay
+                # intact; the full path carries any ?window= query.
                 req = CachedRequest(body, headers, path)
                 conn.inflight.append(req)
                 status, payload, ctype = \
-                    self.serving._metrics_response(bare_path)
+                    self.serving._metrics_response(path)
                 req.respond(status, payload, ctype)
                 self._flush(conn)
                 continue
@@ -673,9 +706,10 @@ class ServingServer:
         return f"http://{host}:{port}"
 
     def _metrics_response(self, path: str) -> tuple:
-        """(status, payload, content_type) for GET /metrics[.json] — the
-        Prometheus/JSON exposition of the process-wide MetricsRegistry
-        (telemetry.exposition; mounted on both transports)."""
+        """(status, payload, content_type) for the exposition GETs —
+        /metrics, /metrics.json[?window=N], /slo — over the process-wide
+        MetricsRegistry / SLO engine (telemetry.exposition; mounted on
+        both transports). `path` keeps its query string."""
         from ..telemetry.exposition import metrics_http_response
         return metrics_http_response(path)
 
@@ -687,17 +721,22 @@ class ServingServer:
         names the root span within it)."""
         tracer = get_tracer()
         headers = req.headers
-        if (tracer.sample_rate <= 0.0
+        tracing_off = (tracer.sample_rate <= 0.0
+                       and tracer.tail_latency_ms is None)
+        if (tracing_off
                 and TRACE_HEADER not in headers
                 and "x-trace-id" not in headers
                 and "X-trace-id" not in headers):
             # disabled fast path: three dict membership tests covering the
             # spellings real clients send (exact, selector-lowercased,
             # urllib-capitalized) — extract()'s per-key scan was measurable
-            # at ingress rates. Exotic casings only join when sampling is on.
+            # at ingress rates. Exotic casings only join when sampling is
+            # on. Tail capture keeps the slow path live: an unsampled
+            # request must still record tentatively so a breach can
+            # promote its full tree.
             return None
         ctx = tracer.extract(headers)
-        if ctx is None and tracer.sample_rate <= 0.0:
+        if ctx is None and tracing_off:
             return None
         return tracer.start_span(
             tnames.SERVING_REQUEST_SPAN, parent=ctx,
@@ -706,6 +745,12 @@ class ServingServer:
 
     # -- ingress ------------------------------------------------------------
     def _enqueue(self, req: CachedRequest):
+        # every real ingress request counts — shed and timed-out ones
+        # included (they're the SLO denominator); exposition self-scrapes
+        # never reach _enqueue on either transport, so /metrics pollers
+        # can't inflate traffic counts or error rates
+        req.slo = True
+        reliability_metrics.inc(tnames.SERVING_REQUEST_TOTAL)
         req.span = self._start_request_span(req)
         if self._draining:
             # drain: in-flight work finishes, NEW work is refused

@@ -24,25 +24,43 @@ signals (queue depth, batch occupancy).
 """
 from __future__ import annotations
 
+import os
 import threading
 from bisect import bisect_right
 from typing import Optional
 
+# Windowed-shard defaults (telemetry/window.py attaches a ring of
+# per-interval shards to every counter/histogram the registry creates):
+# 10 s intervals x 31 shards = 300 s of history, enough for the SLO
+# engine's long burn window. Interval/shard count are env-tunable;
+# shards <= 0 disables windowing entirely.
+WINDOW_INTERVAL_ENV = "MMLSPARK_TPU_WINDOW_INTERVAL"
+WINDOW_SHARDS_ENV = "MMLSPARK_TPU_WINDOW_SHARDS"
+_WINDOW_INTERVAL_DEFAULT = 10.0
+_WINDOW_SHARDS_DEFAULT = 31
+
 
 class Counter:
-    """Monotonic counter; thread-safe."""
+    """Monotonic counter; thread-safe. `window` (attached by the registry
+    from telemetry/window.py) mirrors increments into a time-sharded ring
+    so recent-rate reads don't require tracking counter deltas."""
 
-    __slots__ = ("name", "_value", "_lock")
+    __slots__ = ("name", "_value", "_lock", "window")
 
     def __init__(self, name: str):
         self.name = name
         self._value = 0
         self._lock = threading.Lock()
+        self.window = None
 
     def inc(self, n: int = 1) -> int:
         with self._lock:
             self._value += n
-            return self._value
+            value = self._value
+        w = self.window
+        if w is not None:
+            w.inc(n)
+        return value
 
     @property
     def value(self) -> int:
@@ -79,7 +97,7 @@ class Histogram:
     sample list: a day of traffic must not grow memory)."""
 
     __slots__ = ("name", "_counts", "_count", "_sum_ms", "_min_ms",
-                 "_max_ms", "_lock")
+                 "_max_ms", "_lock", "window")
 
     def __init__(self, name: str):
         self.name = name
@@ -89,6 +107,10 @@ class Histogram:
         self._min_ms = float("inf")
         self._max_ms = 0.0
         self._lock = threading.Lock()
+        # time-sharded ring (telemetry/window.py), attached by the
+        # registry: cumulative and windowed views share ONE bisect per
+        # observation (the shards reuse this histogram's bucket index)
+        self.window = None
 
     def observe_ms(self, ms: float) -> None:
         if ms < 0.0:
@@ -102,6 +124,9 @@ class Histogram:
                 self._min_ms = ms
             if ms > self._max_ms:
                 self._max_ms = ms
+        w = self.window
+        if w is not None:
+            w.observe_idx(idx, ms)
 
     def observe(self, seconds: float) -> None:
         self.observe_ms(seconds * 1000.0)
@@ -131,17 +156,21 @@ class Histogram:
     def snapshot(self) -> dict:
         with self._lock:
             count, total = self._count, self._sum_ms
+            observed_max = self._max_ms
         mean = total / count if count else 0.0
         # `sum`/`mean` (ms) let exposition compute rates without re-walking
         # buckets; existing keys stay stable (mean_ms == mean, kept for
-        # older readers)
+        # older readers). `p999`/`max` expose the extreme tail burn-rate
+        # math and the autotuner steer on.
         return {"count": count,
                 "mean_ms": mean,
                 "sum": total,
                 "mean": mean,
                 "p50": self.percentile(50.0),
                 "p95": self.percentile(95.0),
-                "p99": self.percentile(99.0)}
+                "p99": self.percentile(99.0),
+                "p999": self.percentile(99.9),
+                "max": observed_max}
 
     # -- raw state (exposition / cross-process merge) -------------------------
     def state(self) -> dict:
@@ -177,24 +206,83 @@ class Histogram:
 
 class MetricsRegistry:
     """Named counters, histograms, gauges + wall-clock observations.
-    All methods thread-safe."""
+    All methods thread-safe.
 
-    def __init__(self):
+    Every counter/histogram also carries a WINDOWED view (a ring of
+    per-interval shards, telemetry/window.py): `window_state(window_s)` /
+    `export_state(window_s=...)` return the same mergeable shape as the
+    cumulative export but covering only the last N seconds — the
+    decision-grade signal admission control and autoscaling need (a
+    cumulative percentile mixes the first request with the millionth)."""
+
+    def __init__(self, window_interval_s: Optional[float] = None,
+                 window_shards: Optional[int] = None):
         self._lock = threading.Lock()
         self._counters: dict = {}
         self._timings: dict = {}   # label -> [total_seconds, count]
         self._hists: dict = {}     # name -> Histogram
         self._gauges: dict = {}    # name -> float (last value wins)
+        if window_interval_s is None:
+            window_interval_s = float(os.environ.get(
+                WINDOW_INTERVAL_ENV, _WINDOW_INTERVAL_DEFAULT)
+                or _WINDOW_INTERVAL_DEFAULT)
+        if window_shards is None:
+            window_shards = int(os.environ.get(
+                WINDOW_SHARDS_ENV, _WINDOW_SHARDS_DEFAULT)
+                or _WINDOW_SHARDS_DEFAULT)
+        self._win_interval = float(window_interval_s)
+        self._win_shards = int(window_shards)
+
+    # -- windowed shards ------------------------------------------------------
+    @property
+    def window_span_s(self) -> float:
+        """Guaranteed windowed history: the current shard is partial, so
+        only interval * (shards - 1) seconds are always covered."""
+        if self._win_shards <= 1 or self._win_interval <= 0.0:
+            return 0.0
+        return self._win_interval * (self._win_shards - 1)
+
+    def _attach_window(self, metric, kind: str) -> None:
+        """Give a fresh counter/histogram its time-sharded ring. Lazy
+        import: telemetry/window.py imports THIS module at its top level,
+        so the upward reference must resolve at call time, not import
+        time (same pattern as the exposition mounts in io/serving.py)."""
+        if self._win_shards <= 1 or self._win_interval <= 0.0:
+            return
+        from ..telemetry.window import WindowedCounter, WindowedHistogram
+        cls = WindowedHistogram if kind == "hist" else WindowedCounter
+        metric.window = cls(self._win_interval, self._win_shards)
+
+    def configure_windows(self, interval_s: float, shards: int) -> None:
+        """Re-shard every windowed view (tests shrink the interval to make
+        roll-off observable without waiting wall-clock minutes). Existing
+        windowed contents are discarded — cumulative state is untouched."""
+        with self._lock:
+            self._win_interval = float(interval_s)
+            self._win_shards = int(shards)
+            metrics = ([(h, "hist") for h in self._hists.values()]
+                       + [(c, "counter") for c in self._counters.values()])
+        for metric, kind in metrics:
+            metric.window = None
+            self._attach_window(metric, kind)
 
     def counter(self, name: str) -> Counter:
         with self._lock:
             c = self._counters.get(name)
             if c is None:
                 c = self._counters[name] = Counter(name)
+                self._attach_window(c, "counter")
             return c
 
     def inc(self, name: str, n: int = 1) -> int:
         return self.counter(name).inc(n)
+
+    def peek_counter(self, name: str) -> Optional[Counter]:
+        """Non-creating lookup — readers (the SLO engine, exposition)
+        must not materialize metrics on processes that never record
+        them."""
+        with self._lock:
+            return self._counters.get(name)
 
     def get(self, name: str) -> int:
         with self._lock:
@@ -215,7 +303,13 @@ class MetricsRegistry:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = Histogram(name)
+                self._attach_window(h, "hist")
             return h
+
+    def peek_histogram(self, name: str) -> Optional[Histogram]:
+        """Non-creating lookup (see peek_counter)."""
+        with self._lock:
+            return self._hists.get(name)
 
     def observe_ms(self, name: str, ms: float) -> None:
         self.histogram(name).observe_ms(ms)
@@ -250,11 +344,19 @@ class MetricsRegistry:
                 out[f"{name}.{k}"] = v
         return out
 
-    def export_state(self) -> dict:
+    def export_state(self, window_s: Optional[float] = None) -> dict:
         """JSON-serializable raw state: counters/timings/gauges plus each
         histogram's bucket counts — what `/metrics.json` ships and
         `telemetry.exposition.merge_states` sums across workers (snapshot()
-        percentiles cannot be merged; bucket counts can, exactly)."""
+        percentiles cannot be merged; bucket counts can, exactly).
+
+        `window_s` switches counters and histograms to their WINDOWED
+        view (last N seconds, shard-aligned) in the same mergeable shape;
+        the effective window rides along as `window_s` (clamped to the
+        ring's guaranteed span). Timings and gauges have no windowed form
+        and are passed through cumulative/last-value."""
+        if window_s is not None:
+            return self.window_state(window_s)
         with self._lock:
             counters = {n: c.value for n, c in self._counters.items()}
             timings = {l: list(t) for l, t in self._timings.items()}
@@ -262,6 +364,35 @@ class MetricsRegistry:
             hists = list(self._hists.items())
         return {"counters": counters, "timings": timings, "gauges": gauges,
                 "hists": {n: h.state() for n, h in hists}}
+
+    def window_state(self, window_s: float) -> dict:
+        """Windowed raw state (see export_state). Metrics created before
+        windowing was enabled — or with windowing disabled — are omitted
+        rather than silently reported cumulative."""
+        span = self.window_span_s
+        eff = min(float(window_s), span) if span > 0.0 else 0.0
+        with self._lock:
+            counters = list(self._counters.items())
+            timings = {l: list(t) for l, t in self._timings.items()}
+            gauges = dict(self._gauges)
+            hists = list(self._hists.items())
+        out = {"window_s": eff, "window_requested_s": float(window_s),
+               "counters": {}, "timings": timings, "gauges": gauges,
+               "hists": {}}
+        for name, c in counters:
+            if c.window is not None:
+                out["counters"][name] = c.window.total(eff)
+        for name, h in hists:
+            if h.window is not None:
+                out["hists"][name] = h.window.state(eff)
+        return out
+
+    def window_snapshot(self, window_s: float) -> dict:
+        """Flat snapshot()-shaped view of the last N seconds — windowed
+        percentiles are recomputed from the merged shard buckets, never
+        averaged across shards."""
+        from ..telemetry.exposition import state_snapshot
+        return state_snapshot(self.window_state(window_s))
 
     def reset(self, prefix: Optional[str] = None) -> None:
         """Zero counters/timings/histograms/gauges (tests isolate scenarios

@@ -1,7 +1,8 @@
 """Telemetry subsystem: request-scoped span tracing, cross-process metrics
-exposition, and profiling hooks (docs/observability.md).
+exposition, windowed aggregation, SLO burn rates, tail-based trace
+capture, and profiling hooks (docs/observability.md).
 
-Three pillars:
+Pillars:
 
 - **Spans** (`telemetry.spans`): `Tracer`/`Span` with contextvar parent
   linkage, deterministic head sampling, a bounded ring buffer, JSONL
@@ -14,6 +15,21 @@ Three pillars:
   plus `scrape_cluster()` which pulls and exactly merges every registered
   worker's snapshot (bucket-level histogram merge, not percentile
   averaging).
+- **Windows** (`telemetry.window`): a ring of per-interval shards under
+  every counter/histogram — `/metrics.json?window=60` and
+  `MetricsRegistry.window_snapshot()` answer with percentiles over the
+  LAST N seconds (bounded memory, shard-merged, never averaged).
+- **SLOs** (`telemetry.slo`): declared objectives (latency quantile
+  bounds, error-rate budgets) evaluated as multi-window burn rates over
+  the windowed shards; `GET /slo` per worker, merged fleet-wide by
+  `scrape_cluster(slo=True)`.
+- **Tail capture** (`telemetry.spans`): a second sampling stage that
+  retroactively keeps the full span tree of any trace whose root
+  finished slow, errored, or 5xx — coexists with the deterministic 1%
+  head sample.
+- **Retention** (`telemetry.poller`): `TelemetryPoller` polls the fleet
+  on an interval and keeps a bounded JSONL-exportable series — the
+  autotuner/control-plane data substrate.
 - **Hooks**: serving request path, `data.DevicePrefetcher`,
   `TrainingSupervisor` step/checkpoint lifecycle, `fit_booster`
   iterations, `utils.tracing.trace` device profiles (stamped with the
@@ -26,31 +42,43 @@ Sampling defaults OFF (env `MMLSPARK_TPU_TRACE_SAMPLE`, or
 compare per site (`BENCH_MODE=telemetry` pins the off/1%/full A/B).
 """
 from .spans import (CAPACITY_ENV, REQUEST_ID_HEADER, SAMPLE_ENV, Span,
-                    SpanContext, TRACE_HEADER, Tracer, configure, get_tracer,
-                    head_sampled, new_id, parse_trace_header, read_jsonl,
-                    wall_now)
+                    SpanContext, TAIL_ENV, TRACE_HEADER, Tracer, configure,
+                    get_tracer, head_sampled, new_id, parse_trace_header,
+                    read_jsonl, wall_now)
 
-# exposition re-exports are LAZY: spans.py is the stdlib-only layer every
-# subsystem imports (`from ..telemetry.spans import get_tracer`), and that
-# import executes this __init__ — an eager exposition import would pull
-# reliability.metrics into every low layer and re-open the circular-import
-# door spans.py exists to close.
-_EXPOSITION_NAMES = frozenset((
-    "ClusterSnapshot", "PROM_CONTENT_TYPE", "merge_states",
-    "metrics_http_response", "render_prometheus", "scrape_cluster",
-    "state_snapshot"))
+# exposition/window/slo/poller re-exports are LAZY: spans.py is the
+# stdlib-only layer every subsystem imports
+# (`from ..telemetry.spans import get_tracer`), and that import executes
+# this __init__ — an eager import here would pull reliability.metrics into
+# every low layer and re-open the circular-import door spans.py exists to
+# close.
+_LAZY_NAMES = {
+    "ClusterSnapshot": "exposition", "PROM_CONTENT_TYPE": "exposition",
+    "merge_states": "exposition", "metrics_http_response": "exposition",
+    "render_prometheus": "exposition", "scrape_cluster": "exposition",
+    "state_snapshot": "exposition",
+    "WindowedCounter": "window", "WindowedHistogram": "window",
+    "Objective": "slo", "SLOEngine": "slo", "default_objectives": "slo",
+    "merge_verdicts": "slo",
+    "TelemetryPoller": "poller",
+}
 
 
 def __getattr__(name):
-    if name in _EXPOSITION_NAMES:
-        from . import exposition
-        return getattr(exposition, name)
+    mod = _LAZY_NAMES.get(name)
+    if mod is not None:
+        import importlib
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
            "head_sampled", "new_id", "parse_trace_header", "read_jsonl",
            "wall_now",
            "TRACE_HEADER", "REQUEST_ID_HEADER", "SAMPLE_ENV", "CAPACITY_ENV",
+           "TAIL_ENV",
            "render_prometheus", "metrics_http_response", "merge_states",
            "state_snapshot", "scrape_cluster", "ClusterSnapshot",
-           "PROM_CONTENT_TYPE"]
+           "PROM_CONTENT_TYPE",
+           "WindowedHistogram", "WindowedCounter",
+           "Objective", "SLOEngine", "default_objectives", "merge_verdicts",
+           "TelemetryPoller"]

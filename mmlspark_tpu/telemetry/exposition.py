@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import re
+import urllib.parse
 import urllib.request
 from typing import NamedTuple, Optional
 
@@ -34,6 +35,11 @@ from ..reliability.metrics import (Histogram, MetricsRegistry,
                                    histogram_bounds_ms, reliability_metrics)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# windows rendered as Prometheus gauges on GET /metrics (seconds); the
+# JSON form takes any ?window= the ring covers
+PROM_WINDOWS_S = (60.0,)
+_WINDOW_QUANTILES = ((50.0, "0.5"), (99.0, "0.99"), (99.9, "0.999"))
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -50,9 +56,12 @@ def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() else f"{v:.9g}"
 
 
-def render_prometheus(registry=None, state: Optional[dict] = None) -> str:
+def render_prometheus(registry=None, state: Optional[dict] = None,
+                      windows: Optional[tuple] = None) -> str:
     """Render a registry (default: the process-wide `reliability_metrics`)
-    or a raw `export_state()` dict as Prometheus text."""
+    or a raw `export_state()` dict as Prometheus text. `windows` selects
+    the lookbacks for the windowed quantile gauges (default
+    `PROM_WINDOWS_S`; only a live registry carries shards to render)."""
     if state is None:
         reg = registry if registry is not None else reliability_metrics
         state = reg.export_state()
@@ -90,17 +99,78 @@ def render_prometheus(registry=None, state: Optional[dict] = None) -> str:
         lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
         lines.append(f"{pn}_sum {_fmt(h['sum_ms'] / 1000.0)}")
         lines.append(f"{pn}_count {h['count']}")
+    if registry is not None or state is None:
+        reg = registry if registry is not None else reliability_metrics
+        lines.extend(_render_window_gauges(
+            reg, windows if windows is not None else PROM_WINDOWS_S))
     return "\n".join(lines) + "\n"
 
 
+def _render_window_gauges(reg, windows) -> list:
+    """Windowed quantile gauges next to the cumulative series: one gauge
+    family per histogram, labeled by window and quantile (plus the
+    windowed count so rates are readable). Only rendered from a LIVE
+    registry — a raw state dict carries no shards."""
+    lines: list = []
+    for window_s in windows:
+        state = reg.window_state(window_s)
+        win = _fmt(state["window_s"])
+        for name in sorted(state.get("hists", {})):
+            h = Histogram.from_state(name, state["hists"][name])
+            pn = prom_name(name)
+            lines.append(f"# HELP {pn}_window_seconds {name} windowed "
+                         f"quantiles (last {win}s, shard-merged)")
+            lines.append(f"# TYPE {pn}_window_seconds gauge")
+            for q, label in _WINDOW_QUANTILES:
+                lines.append(
+                    f'{pn}_window_seconds{{window="{win}",'
+                    f'quantile="{label}"}} '
+                    f"{_fmt(h.percentile(q) / 1000.0)}")
+            lines.append(f'{pn}_window_count{{window="{win}"}} '
+                         f"{h.count}")
+    return lines
+
+
+def _parse_window(path: str):
+    """(base_path, window_s | None) from a request path; raises
+    ValueError on a malformed window so callers 400 instead of silently
+    serving cumulative numbers to an autoscaler that asked for recent."""
+    base, _, query = path.partition("?")
+    values = urllib.parse.parse_qs(query).get("window")
+    if not values:
+        return base, None
+    window_s = float(values[-1])
+    # `not (> 0)` rather than `<= 0`: NaN fails both comparisons and must
+    # land in the 400, not raise deep inside the shard merge
+    if not (window_s > 0.0):
+        raise ValueError(f"window must be > 0, got {window_s}")
+    return base, window_s
+
+
 def metrics_http_response(path: str, registry=None) -> tuple:
-    """(status, payload_bytes, content_type) for a `/metrics[.json]` GET —
-    the shared handler body `ServingServer` and `ServiceRegistry` mount."""
+    """(status, payload_bytes, content_type) for the exposition GETs —
+    `/metrics`, `/metrics.json[?window=N]`, and `/slo` — the shared
+    handler body `ServingServer` and `ServiceRegistry` mount."""
     reg = registry if registry is not None else reliability_metrics
-    if path.startswith("/metrics.json"):
-        return 200, json.dumps(reg.export_state()).encode(), \
+    try:
+        base, window_s = _parse_window(path)
+    except ValueError as e:
+        return 400, json.dumps({"error": str(e)}).encode(), \
             "application/json"
-    return 200, render_prometheus(reg).encode(), PROM_CONTENT_TYPE
+    if base == "/slo":
+        from .slo import get_engine
+        return 200, json.dumps(get_engine().verdict()).encode(), \
+            "application/json"
+    if base == "/metrics.json":
+        return 200, \
+            json.dumps(reg.export_state(window_s=window_s)).encode(), \
+            "application/json"
+    # /metrics honors ?window= too: it selects the windowed-gauge
+    # lookback (the cumulative series are part of the Prometheus
+    # contract and always render)
+    windows = (window_s,) if window_s is not None else None
+    return 200, render_prometheus(reg, windows=windows).encode(), \
+        PROM_CONTENT_TYPE
 
 
 # ---------------------------------------------------------------- merging
@@ -108,6 +178,11 @@ def merge_states(states: list) -> dict:
     """Merge raw `export_state()` dicts: counters/timings sum, histogram
     buckets sum elementwise, gauges keep max (see module docstring)."""
     merged = {"counters": {}, "timings": {}, "gauges": {}, "hists": {}}
+    windows = [st["window_s"] for st in states if "window_s" in st]
+    if windows:
+        # a merged windowed state keeps the NARROWEST effective window —
+        # the honest label when rings were configured unevenly
+        merged["window_s"] = min(windows)
     for st in states:
         for name, v in st.get("counters", {}).items():
             merged["counters"][name] = merged["counters"].get(name, 0) + v
@@ -153,19 +228,29 @@ def state_snapshot(state: dict) -> dict:
 
 class ClusterSnapshot(NamedTuple):
     """`scrape_cluster` result: the exactly-merged flat snapshot plus each
-    worker's raw state for per-host drill-down."""
+    worker's raw state for per-host drill-down. `slo` is the fleet-merged
+    `/slo` verdict when the scrape asked for it (None otherwise)."""
     merged: dict
     workers: list   # [(ServiceInfo, raw state dict), ...]
+    slo: Optional[dict] = None
 
 
 def scrape_cluster(registry_address: str, name: Optional[str] = None,
                    timeout: float = 10.0,
-                   skip_unreachable: bool = True) -> ClusterSnapshot:
+                   skip_unreachable: bool = True,
+                   window: Optional[float] = None,
+                   slo: bool = False) -> ClusterSnapshot:
     """Pull `/metrics.json` from every worker the `ServiceRegistry` at
     `registry_address` knows (optionally one service `name`) and merge.
     A worker that died between registering and the scrape is skipped (its
     numbers are gone either way); pass `skip_unreachable=False` to raise
-    instead."""
+    instead.
+
+    `window` scrapes `/metrics.json?window=N` — the merged snapshot then
+    covers only each worker's last N seconds (bucket counts still sum
+    elementwise; percentiles recompute from the merged windowed buckets).
+    `slo=True` also pulls each worker's `/slo` verdict and merges them
+    with `telemetry.slo.merge_verdicts` (counts sum, burns recompute)."""
     from ..io.registry import ServiceInfo, list_services
     if name is not None:
         infos = list_services(registry_address, name, timeout=timeout)
@@ -173,16 +258,32 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
         with urllib.request.urlopen(registry_address + "/services",
                                     timeout=timeout) as resp:
             infos = [ServiceInfo(**d) for d in json.loads(resp.read())]
+    metrics_path = "/metrics.json"
+    if window is not None:
+        metrics_path += f"?window={float(window):g}"
     workers = []
+    slo_verdicts = []
     for info in infos:
         try:
-            with urllib.request.urlopen(info.address + "/metrics.json",
+            with urllib.request.urlopen(info.address + metrics_path,
                                         timeout=timeout) as resp:
-                workers.append((info, json.loads(resp.read())))
+                state = json.loads(resp.read())
+            if slo:
+                with urllib.request.urlopen(info.address + "/slo",
+                                            timeout=timeout) as resp:
+                    slo_verdicts.append(json.loads(resp.read()))
+            workers.append((info, state))
         except (OSError, ValueError) as e:
             if not skip_unreachable:
                 raise RuntimeError(
                     f"scrape of {info.address} failed: {e}") from e
-    merged = state_snapshot(merge_states([st for _, st in workers]))
+    merged_state = merge_states([st for _, st in workers])
+    merged = state_snapshot(merged_state)
     merged["telemetry.scrape.workers"] = len(workers)
-    return ClusterSnapshot(merged=merged, workers=workers)
+    if "window_s" in merged_state:
+        merged["telemetry.scrape.window_s"] = merged_state["window_s"]
+    merged_slo = None
+    if slo:
+        from .slo import merge_verdicts
+        merged_slo = merge_verdicts(slo_verdicts)
+    return ClusterSnapshot(merged=merged, workers=workers, slo=merged_slo)

@@ -30,6 +30,10 @@ from __future__ import annotations
 
 # --------------------------------------------------------------- counters
 SERVING_SHED_REQUESTS = "serving.shed_requests"
+SERVING_REQUEST_TOTAL = "serving.request.total"
+SERVING_REQUEST_ERRORS = "serving.request.errors"
+TELEMETRY_POLL_SAMPLES = "telemetry.poll.samples"
+TELEMETRY_POLL_ERRORS = "telemetry.poll.errors"
 SERVING_WORKER_RESTARTS = "serving.worker_restarts"
 SERVING_REPLAYED_EPOCHS = "serving.replayed_epochs"
 SERVING_SIGNAL_DRAINS = "serving.signal_drains"
@@ -62,6 +66,13 @@ DATA_PREFETCH_FULL = "data.prefetch.full"
 COUNTERS = {
     SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
                            "load shedding)",
+    SERVING_REQUEST_TOTAL: "requests accepted at ingress (exposition "
+                           "self-scrapes excluded) — SLO denominators",
+    SERVING_REQUEST_ERRORS: "requests answered 5xx (shed, timeout, model "
+                            "failure) — SLO error-budget numerators",
+    TELEMETRY_POLL_SAMPLES: "fleet snapshots captured by TelemetryPoller",
+    TELEMETRY_POLL_ERRORS: "TelemetryPoller scrape rounds that failed "
+                           "(absorbed; last good sample stands)",
     SERVING_WORKER_RESTARTS: "partition worker threads restarted by the "
                              "watchdog",
     SERVING_REPLAYED_EPOCHS: "uncommitted epochs replayed after a worker "

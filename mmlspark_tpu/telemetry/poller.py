@@ -1,0 +1,142 @@
+"""TelemetryPoller: periodic fleet scrapes with bounded in-memory
+retention.
+
+`scrape_cluster` is a one-shot pull — it answers "what is the fleet doing
+NOW" and forgets. The consumers ROADMAP items 3/4 describe need history:
+the autotuner fits latency models per (op, shape-bucket) from *series*,
+and autoscaling triggers on *sustained* occupancy, not one reading. The
+poller is that substrate: a daemon thread polls every registered worker
+on an interval (windowed metrics + the merged `/slo` verdict) and keeps
+the last `history` samples in a ring (`collections.deque(maxlen=...)`) —
+a day of polling cannot grow memory, same contract as the span ring.
+
+Each sample is one flat dict (plus the fleet SLO verdict), so a series
+read is a list comprehension and the JSONL export replays into any
+offline fitting job:
+
+    poller = TelemetryPoller(registry.address, interval_s=10, window_s=60)
+    poller.start()
+    ...
+    poller.series("serving.request.e2e.p99")   # [(t, p99_ms), ...]
+    poller.latest()["slo"]["ok"]
+    poller.export_jsonl("/tmp/fleet.jsonl")
+    poller.stop()
+
+Scrape failures are counted (`telemetry.poll.errors`) and absorbed — a
+registry hiccup leaves a gap in the series, never a dead poller.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Optional
+
+from ..reliability.metrics import reliability_metrics
+from . import names as tnames
+from .exposition import scrape_cluster
+from .spans import wall_now
+
+
+class TelemetryPoller:
+    """Bounded-retention fleet poller (see module docstring)."""
+
+    def __init__(self, registry_address: str, name: Optional[str] = None,
+                 interval_s: float = 10.0, window_s: Optional[float] = 60.0,
+                 history: int = 720, timeout: float = 5.0,
+                 slo: bool = True):
+        if interval_s <= 0.0:
+            raise ValueError("interval_s must be > 0")
+        self.registry_address = registry_address
+        self.name = name
+        self.interval_s = float(interval_s)
+        self.window_s = window_s
+        self.timeout = float(timeout)
+        self.slo = bool(slo)
+        self._samples: deque = deque(maxlen=max(int(history), 1))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "TelemetryPoller":
+        if self._thread is not None:
+            raise RuntimeError("poller already started")
+        self._stop.clear()   # a stopped poller may be restarted
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="telemetry-poller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout + 10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        # first sample immediately, then every interval; Event.wait is
+        # the sleep AND the stop signal (no polling loop inside a lock)
+        while True:
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - gap in the series, not death
+                reliability_metrics.inc(tnames.TELEMETRY_POLL_ERRORS)
+            if self._stop.wait(self.interval_s):
+                return
+
+    # -- sampling ------------------------------------------------------------
+    def poll_once(self) -> dict:
+        """One scrape round (also callable without start() for manual
+        cadence). Raises on scrape failure — the loop absorbs, callers
+        see the error."""
+        snap = scrape_cluster(self.registry_address, name=self.name,
+                              timeout=self.timeout, window=self.window_s,
+                              slo=self.slo)
+        sample = {"t": wall_now(),
+                  "workers": snap.merged.get("telemetry.scrape.workers", 0),
+                  "window_s": snap.merged.get("telemetry.scrape.window_s"),
+                  "metrics": snap.merged,
+                  "slo": snap.slo}
+        with self._lock:
+            self._samples.append(sample)
+        reliability_metrics.inc(tnames.TELEMETRY_POLL_SAMPLES)
+        return sample
+
+    # -- read side -----------------------------------------------------------
+    def samples(self) -> list:
+        """All retained samples, oldest first."""
+        with self._lock:
+            return list(self._samples)
+
+    def latest(self) -> Optional[dict]:
+        with self._lock:
+            return self._samples[-1] if self._samples else None
+
+    def series(self, key: str) -> list:
+        """[(t, value), ...] for one merged-metric key across retained
+        samples; samples missing the key are skipped (a worker fleet that
+        hasn't emitted the metric yet leaves a gap, not a zero)."""
+        out = []
+        for s in self.samples():
+            v = s["metrics"].get(key)
+            if v is not None:
+                out.append((s["t"], v))
+        return out
+
+    def export_jsonl(self, path: str) -> int:
+        """One sample per line, oldest first — the offline-fitting feed
+        (same convention as `Tracer.export_jsonl`)."""
+        samples = self.samples()
+        with open(path, "w") as f:
+            for s in samples:
+                f.write(json.dumps(s) + "\n")
+        return len(samples)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"samples": len(self._samples),
+                    "capacity": self._samples.maxlen,
+                    "interval_s": self.interval_s,
+                    "running": self._thread is not None
+                    and self._thread.is_alive()}

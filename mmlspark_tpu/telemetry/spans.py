@@ -25,6 +25,16 @@ Design:
   trace id independently reaches the same decision (no sampled-flag drift
   between hosts on the same trace). A propagated `X-Trace-Id` header also
   carries the decision explicitly, which wins over recomputation.
+- **Tail-based capture (second stage)**: with `tail_latency_ms` set, a
+  head-UNSAMPLED trace still records — tentatively, into a bounded
+  pending buffer keyed by trace id — and the whole tree is promoted to
+  the ring when its ROOT span finishes slow (>= threshold), errored, or
+  with a 5xx status; fast clean traces are discarded wholesale. The 1%
+  head sample stays a statistically honest picture of ALL traffic while
+  every slow/failed request keeps a full span tree. Tentative traces
+  never inject propagation headers (the local process can't promise the
+  fleet a trace it may yet discard), and eviction is deterministic
+  (oldest pending trace first; per-trace span cap) — see stats().
 - Propagation: `X-Trace-Id: <trace_id>:<parent_span_id>:<0|1>`. A bare
   value with no `:` is accepted as a sampled trace id (curl-friendly).
 - Zero overhead disabled: `sample_rate == 0` with no incoming context makes
@@ -57,9 +67,20 @@ from typing import Callable, NamedTuple, Optional
 TRACE_HEADER = "X-Trace-Id"
 REQUEST_ID_HEADER = "X-Request-Id"
 # env knobs: sampling rate for the process-default tracer (0 = off, the
-# production-safe default; serving tests/benches opt in) and ring capacity
+# production-safe default; serving tests/benches opt in), ring capacity,
+# and the tail-capture latency threshold in ms (unset/<=0 = off)
 SAMPLE_ENV = "MMLSPARK_TPU_TRACE_SAMPLE"
 CAPACITY_ENV = "MMLSPARK_TPU_TRACE_CAPACITY"
+TAIL_ENV = "MMLSPARK_TPU_TRACE_TAIL_MS"
+
+# tail-capture bounds: pending traces awaiting their root's verdict, and
+# spans buffered per pending trace (a runaway recursive trace must not
+# grow memory); both deterministic — overflow evicts the OLDEST pending
+# trace / drops further spans, counted in stats()
+TAIL_PENDING_TRACES = 256
+TAIL_SPANS_PER_TRACE = 512
+
+_UNSET = object()
 
 
 class SpanContext(NamedTuple):
@@ -180,30 +201,64 @@ class Tracer:
     """Span factory + bounded ring of finished spans. Thread-safe."""
 
     def __init__(self, sample: Optional[float] = None,
-                 capacity: Optional[int] = None):
+                 capacity: Optional[int] = None,
+                 tail_latency_ms: Optional[float] = _UNSET):
         if sample is None:
             sample = float(os.environ.get(SAMPLE_ENV, "0") or 0)
         if capacity is None:
             capacity = int(os.environ.get(CAPACITY_ENV, "4096") or 4096)
+        if tail_latency_ms is _UNSET:
+            tail = float(os.environ.get(TAIL_ENV, "0") or 0)
+            tail_latency_ms = tail if tail > 0.0 else None
         self._lock = threading.Lock()
         self._sample = float(sample)
         self._spans: deque = deque(maxlen=max(int(capacity), 1))
         self._dropped = 0
         self._seq = itertools.count()
+        # tail-capture second stage (see module docstring): head-unsampled
+        # traces buffer here until their ROOT finishes, then the whole
+        # tree is kept (breach) or discarded (fast + clean)
+        self._tail_ms = (None if tail_latency_ms is None
+                         else float(tail_latency_ms))
+        self._pending: dict = {}    # trace_id -> {"root": sid, "spans": []}
+        self._pending_cap = TAIL_PENDING_TRACES
+        # evicted pending traces leave a bounded tombstone so their late
+        # spans (children in flight, the root's eventual finish) are
+        # dropped instead of leaking into the ring unsampled
+        self._tombstones: dict = {}   # trace_id -> None, insertion-ordered
+        self._tail_kept = 0
+        self._tail_dropped = 0
+        self._tail_evicted = 0
 
     # -- configuration -------------------------------------------------------
     @property
     def sample_rate(self) -> float:
         return self._sample
 
+    @property
+    def tail_latency_ms(self) -> Optional[float]:
+        """Tail-capture threshold (ms); None = tail stage off."""
+        return self._tail_ms
+
     def configure(self, sample: Optional[float] = None,
-                  capacity: Optional[int] = None) -> "Tracer":
+                  capacity: Optional[int] = None,
+                  tail_latency_ms=_UNSET,
+                  tail_pending: Optional[int] = None) -> "Tracer":
         with self._lock:
             if sample is not None:
                 self._sample = float(sample)
             if capacity is not None:
                 self._spans = deque(self._spans,
                                     maxlen=max(int(capacity), 1))
+            if tail_latency_ms is not _UNSET:
+                # None disables; a number (ms) enables the second stage
+                self._tail_ms = (None if tail_latency_ms is None
+                                 else float(tail_latency_ms))
+                if self._tail_ms is None:
+                    self._pending.clear()
+                    self._tombstones.clear()
+            if tail_pending is not None:
+                self._pending_cap = max(int(tail_pending), 1)
         return self
 
     # -- context propagation -------------------------------------------------
@@ -237,6 +292,15 @@ class Tracer:
         if headers is None:
             headers = {}
         if ctx is not None and ctx.sampled:
+            # a TENTATIVE (tail-pending) trace must not propagate as
+            # sampled: the header would force every downstream process to
+            # record a trace whose fate this process hasn't decided yet.
+            # Evicted/discarded traces (tombstoned) stay silent too —
+            # their local spans are already gone.
+            if ((self._pending and ctx.trace_id in self._pending)
+                    or (self._tombstones
+                        and ctx.trace_id in self._tombstones)):
+                return headers
             headers[TRACE_HEADER] = ctx.header_value()
         return headers
 
@@ -274,12 +338,29 @@ class Tracer:
                 return None
             tid, pid = parent.trace_id, parent.span_id or None
         else:
-            if self._sample <= 0.0:
+            tail = self._tail_ms
+            if self._sample <= 0.0 and tail is None:
                 return None
             tid = trace_id if trace_id is not None else new_id()
-            if not head_sampled(tid, self._sample):
-                return None
             pid = None
+            if not head_sampled(tid, self._sample):
+                if tail is None:
+                    return None
+                # tail second stage: record TENTATIVELY — the trace
+                # buffers in _pending until this root span finishes, and
+                # is kept only if the root breached (slow/error/5xx)
+                sid = span_id or new_id()
+                with self._lock:
+                    if tid not in self._pending:
+                        if len(self._pending) >= self._pending_cap:
+                            # deterministic eviction: oldest pending trace
+                            oldest = next(iter(self._pending))
+                            gone = self._pending.pop(oldest)
+                            self._tail_evicted += 1 + len(gone["spans"])
+                            self._tombstone(oldest)
+                        self._tombstones.pop(tid, None)
+                        self._pending[tid] = {"root": sid, "spans": []}
+                return Span(self, name, tid, sid, pid, attrs)
         return Span(self, name, tid, span_id or new_id(), pid, attrs)
 
     @contextlib.contextmanager
@@ -352,14 +433,65 @@ class Tracer:
         return self.record(label, duration_ms=seconds * 1000.0)
 
     # -- ring buffer / export ------------------------------------------------
+    def _tombstone(self, trace_id: str) -> None:
+        """Remember (bounded, oldest-out) that a tentative trace was
+        evicted/discarded: its late spans drop instead of leaking into
+        the ring unsampled, and it never injects headers. Caller holds
+        the tracer lock."""
+        if len(self._tombstones) >= self._pending_cap:
+            self._tombstones.pop(next(iter(self._tombstones)))
+        self._tombstones[trace_id] = None
+
+    def _tail_breach(self, d: dict) -> bool:
+        """Did this root span earn its trace a place in the ring? Slow
+        (>= threshold), errored, or answered 5xx — 'every slow/failed
+        request has a full span tree'."""
+        tail = self._tail_ms
+        if tail is not None and d["duration_ms"] >= tail:
+            return True
+        attrs = d["attrs"]
+        if attrs.get("error") is not None:
+            return True
+        status = attrs.get("status")
+        return isinstance(status, int) and status >= 500
+
+    def _ring_append(self, d: dict) -> None:
+        if len(self._spans) == self._spans.maxlen:
+            self._dropped += 1
+        self._spans.append(d)
+
     def _append(self, span: Span) -> None:
         d = span.to_dict()
         with self._lock:
             d["seq"] = next(self._seq)
             d["pid"] = os.getpid()
-            if len(self._spans) == self._spans.maxlen:
-                self._dropped += 1
-            self._spans.append(d)
+            if self._tombstones and span.trace_id in self._tombstones:
+                self._tail_dropped += 1   # late span of an evicted trace
+                return
+            if self._pending:
+                entry = self._pending.get(span.trace_id)
+                if entry is not None:
+                    if span.span_id == entry["root"]:
+                        # the root's finish is the tail decision point
+                        del self._pending[span.trace_id]
+                        if self._tail_breach(d):
+                            d["attrs"] = dict(d["attrs"], tail=True)
+                            self._tail_kept += 1
+                            for s in entry["spans"]:
+                                self._ring_append(s)
+                            self._ring_append(d)
+                        else:
+                            self._tail_dropped += 1 + len(entry["spans"])
+                            # discarded wholesale means late stragglers
+                            # too: a child finishing after its fast root
+                            # must not leak into the ring
+                            self._tombstone(span.trace_id)
+                    elif len(entry["spans"]) < TAIL_SPANS_PER_TRACE:
+                        entry["spans"].append(d)
+                    else:
+                        self._tail_dropped += 1
+                    return
+            self._ring_append(d)
 
     def finished(self, name: Optional[str] = None) -> list:
         """Finished span dicts in seq (causal) order; `name` filters."""
@@ -383,13 +515,23 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._pending.clear()
+            self._tombstones.clear()
             self._dropped = 0
+            self._tail_kept = 0
+            self._tail_dropped = 0
+            self._tail_evicted = 0
 
     def stats(self) -> dict:
         with self._lock:
             return {"spans": len(self._spans), "dropped": self._dropped,
                     "capacity": self._spans.maxlen,
-                    "sample_rate": self._sample}
+                    "sample_rate": self._sample,
+                    "tail_latency_ms": self._tail_ms,
+                    "tail_pending": len(self._pending),
+                    "tail_kept": self._tail_kept,
+                    "tail_dropped": self._tail_dropped,
+                    "tail_evicted": self._tail_evicted}
 
 
 def read_jsonl(path: str) -> list:
@@ -415,6 +557,11 @@ def get_tracer() -> Tracer:
 
 
 def configure(sample: Optional[float] = None,
-              capacity: Optional[int] = None) -> Tracer:
-    """Configure the process-default tracer (sampling rate / ring size)."""
-    return _default.configure(sample=sample, capacity=capacity)
+              capacity: Optional[int] = None,
+              tail_latency_ms=_UNSET,
+              tail_pending: Optional[int] = None) -> Tracer:
+    """Configure the process-default tracer (sampling rate / ring size /
+    tail-capture threshold)."""
+    return _default.configure(sample=sample, capacity=capacity,
+                              tail_latency_ms=tail_latency_ms,
+                              tail_pending=tail_pending)

@@ -1,0 +1,614 @@
+"""Windowed telemetry, SLO engine, tail-based trace capture (ISSUE 7).
+
+Pins the decision-grade signal contracts: windowed percentiles roll off
+expired intervals exactly (fake clock, no wall-clock sleeps for the
+core math); a live worker answers `/metrics.json?window=` with recent
+percentiles a cumulative snapshot cannot see; the tail sampler keeps a
+breaching trace's full tree and drops a fast one deterministically on
+both transports; `scrape_cluster` merges windowed bucket counts
+elementwise (never averages percentiles) and merges `/slo` verdicts by
+summing counts; an injected FaultInjector latency fault flips the SLO
+verdict; exposition self-scrapes never inflate `serving.request.*`; and
+the TelemetryPoller retains a bounded, exportable series."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.reliability.faults import FaultInjector
+from mmlspark_tpu.reliability.metrics import (Histogram, MetricsRegistry,
+                                              reliability_metrics)
+from mmlspark_tpu.telemetry import (Objective, SLOEngine, Tracer,
+                                    TelemetryPoller, WindowedCounter,
+                                    WindowedHistogram, head_sampled,
+                                    merge_states, merge_verdicts,
+                                    render_prometheus, scrape_cluster,
+                                    state_snapshot)
+from mmlspark_tpu.telemetry import window as twindow
+from mmlspark_tpu.telemetry import slo as tslo
+from mmlspark_tpu.telemetry import names as tnames
+
+
+@pytest.fixture
+def fast_windows():
+    """Shrink the process registry's window shards so roll-off happens in
+    fractions of a second; restore the defaults (and a clean registry)
+    after."""
+    reliability_metrics.reset()
+    reliability_metrics.configure_windows(0.25, 40)   # 9.75 s span
+    yield reliability_metrics
+    reliability_metrics.reset()
+    reliability_metrics.configure_windows(10.0, 31)
+
+
+@pytest.fixture
+def tail_tracer():
+    """Process-default tracer with head sampling OFF and tail capture ON
+    (150 ms threshold — wide margin over a contended host's echo
+    latency); restored fully off after."""
+    tr = telemetry.get_tracer()
+    tr.configure(sample=0.0, capacity=4096, tail_latency_ms=150.0)
+    tr.clear()
+    yield tr
+    tr.configure(sample=0.0, tail_latency_ms=None)
+    tr.clear()
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp, json.loads(resp.read())
+
+
+def _get_json(url, timeout=15):
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+def _serving(transform=None, **server_kw):
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+
+    server = ServingServer(num_partitions=1, **server_kw).start()
+
+    def echo(bodies):
+        return [{"echo": json.loads(b)["x"]} for b in bodies]
+
+    query = ServingQuery(server, transform or echo,
+                         mode="continuous").start()
+    return server, query
+
+
+# ----------------------------------------------------------- window math
+def test_windowed_histogram_rolls_off_expired_intervals_exactly():
+    """The defining property: a shard older than the window contributes
+    NOTHING — driven by a fake clock, so the boundary is exact."""
+    t = [1000.0]
+    h = WindowedHistogram(10.0, 4, clock=lambda: t[0])
+    h.observe_idx(0, 0.5)
+    h.observe_idx(0, 0.5)          # shard [1000, 1010)
+    t[0] = 1015.0
+    h.observe_idx(0, 0.7)          # shard [1010, 1020)
+    assert h.state(30.0)["count"] == 3
+    # at t=1025 a 10s window reaches back to 1015: shard [1010,1020)
+    # overlaps and stays; shard [1000,1010) is fully expired
+    t[0] = 1025.0
+    assert h.state(10.0)["count"] == 1
+    assert h.state(10.0)["sum_ms"] == pytest.approx(0.7)
+    # at t=1030.0 the window (1020, 1030] no longer overlaps [1010,1020)
+    t[0] = 1030.0
+    assert h.state(10.0)["count"] == 0
+    assert h.state(10.0)["min_ms"] is None
+    # wider window still sees it (shard not yet overwritten)
+    assert h.state(20.0)["count"] == 1
+
+
+def test_windowed_ring_is_bounded_and_reuses_slots():
+    """Hundreds of intervals, constant memory: old shards are RESET in
+    place when their slot comes around again."""
+    t = [0.0]
+    c = WindowedCounter(1.0, 4, clock=lambda: t[0])
+    for k in range(100):
+        t[0] = float(k)
+        c.inc(1)
+    assert len(c._totals) == 4
+    # only the last 4 intervals survive; a 2s window at t=99 reaches
+    # back to 97 and overlaps the shards for seconds 97, 98, 99
+    assert c.total(2.0) == 3
+    assert c.total(100.0) == 4     # ring span caps lookback
+
+
+def test_registry_window_snapshot_recomputes_percentiles(fast_windows):
+    reg = fast_windows
+    for _ in range(50):
+        reg.observe_ms("winslo.lat", 1.0)
+    reg.inc("winslo.hits", 3)
+    snap = reg.window_snapshot(8.0)
+    assert snap["winslo.lat.count"] == 50
+    assert snap["winslo.hits"] == 3
+    assert snap["winslo.lat.p99"] == pytest.approx(1.0, rel=0.2)
+    # roll past the window: the recent view empties, cumulative does not
+    time.sleep(0.6)
+    assert reg.window_snapshot(0.25)["winslo.lat.count"] == 0
+    assert reg.snapshot()["winslo.lat.count"] == 50
+
+
+def test_window_state_clamps_to_ring_span():
+    reg = MetricsRegistry(window_interval_s=0.5, window_shards=5)
+    reg.observe_ms("clamp.lat", 2.0)
+    st = reg.window_state(9999.0)
+    assert st["window_s"] == pytest.approx(2.0)      # 0.5 * (5 - 1)
+    assert st["window_requested_s"] == 9999.0
+    assert st["hists"]["clamp.lat"]["count"] == 1
+
+
+def test_histogram_snapshot_p999_and_max():
+    h = Histogram("tail.lat")
+    for _ in range(9):
+        h.observe_ms(1.0)
+    h.observe_ms(1000.0)
+    snap = h.snapshot()
+    assert snap["max"] == 1000.0                  # exact, not bucketed
+    assert snap["p999"] >= snap["p99"] >= snap["p50"]
+    assert snap["p999"] == pytest.approx(1000.0, rel=0.1)
+    # stable keys untouched
+    assert {"count", "mean_ms", "sum", "mean", "p50", "p95",
+            "p99"} <= set(snap)
+
+
+def test_window_merge_sums_buckets_never_averages():
+    """Two workers' windowed states merge by elementwise bucket-count
+    sum; the merged p99 lands at the slow worker's tail, which averaging
+    worker percentiles would sink."""
+    t = [0.0]
+    ha, hb = Histogram("m.lat"), Histogram("m.lat")
+    # the real wired path: the owning histogram forwards the bucket
+    # index it computed into its attached window
+    ha.window = WindowedHistogram(10.0, 4, clock=lambda: t[0])
+    hb.window = WindowedHistogram(10.0, 4, clock=lambda: t[0])
+    for _ in range(100):
+        ha.observe_ms(1.0)
+        hb.observe_ms(500.0)
+    sa, sb = ha.window.state(30.0), hb.window.state(30.0)
+    merged = merge_states([{"hists": {"m.lat": sa}},
+                           {"hists": {"m.lat": sb}}])
+    flat = state_snapshot(merged)
+    assert flat["m.lat.count"] == 200
+    # per-worker p99s are ~1 and ~500; their average is ~250. The merged
+    # buckets put p99 at the 500ms tail.
+    assert flat["m.lat.p99"] > 400.0
+    assert flat["m.lat.p50"] < 10.0
+
+
+# ------------------------------------------------ live windowed serving
+@pytest.mark.parametrize("transport", ["selector", "threading"])
+def test_metrics_json_window_param_sees_load_shape_change(
+        fast_windows, transport):
+    """The acceptance path: after a slow phase ages out of the window, a
+    windowed scrape reports only the recent (fast) shape while the
+    cumulative snapshot still carries the old tail. The slow phase is
+    synthetic (60 s observations) so no real request can be mistaken
+    for it."""
+    server, query = _serving(transport=transport)
+    try:
+        e2e = reliability_metrics.histogram(tnames.SERVING_REQUEST_E2E)
+        for _ in range(40):
+            e2e.observe_ms(60_000.0)          # the old load shape
+        time.sleep(0.8)                       # ages past a 0.5s window
+        for i in range(5):
+            _post(server.address, {"x": i})   # recent, real, fast
+        deadline = time.monotonic() + 5.0
+        while e2e.count < 45 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        url = server.address + "/metrics.json"
+        windowed = _get_json(url + "?window=0.5")
+        cumulative = _get_json(url)
+        win_hist = windowed["hists"][tnames.SERVING_REQUEST_E2E]
+        cum_hist = cumulative["hists"][tnames.SERVING_REQUEST_E2E]
+        assert windowed["window_s"] > 0.0
+        # recent view: only (some of) the 5 fast requests — every 60s
+        # synthetic observation rolled off; cumulative still sees all 45.
+        # (>=1 not ==5: on a contended host the earliest posts may age
+        # past the 0.5s window before the scrape lands.)
+        assert 1 <= win_hist["count"] <= 5
+        assert cum_hist["count"] >= 45
+        win_p99 = Histogram.from_state("w", win_hist).percentile(99.0)
+        cum_p99 = Histogram.from_state("c", cum_hist).percentile(99.0)
+        assert win_p99 < 30_000.0 < cum_p99
+        # malformed windows answer 400, not silently-cumulative — NaN
+        # included (it passes naive <=0 checks)
+        for bad in ("nope", "nan", "-1", "0"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{url}?window={bad}", timeout=15)
+            assert ei.value.code == 400, bad
+    finally:
+        query.stop()
+        server.stop()
+
+
+def test_prometheus_renders_windowed_gauges(fast_windows):
+    reliability_metrics.observe_ms(tnames.SERVING_REQUEST_E2E, 2.0)
+    text = render_prometheus(reliability_metrics)
+    assert "serving_request_e2e_window_seconds{window=" in text
+    assert 'quantile="0.99"' in text
+    assert "serving_request_e2e_window_count{" in text
+    # raw-state rendering carries no shards and no window gauges
+    no_win = render_prometheus(state=reliability_metrics.export_state())
+    assert "window_seconds{" not in no_win
+
+
+def test_prometheus_metrics_honors_window_param(fast_windows):
+    """GET /metrics?window=N selects the gauge lookback instead of being
+    silently ignored."""
+    from mmlspark_tpu.telemetry import metrics_http_response
+    reliability_metrics.observe_ms(tnames.SERVING_REQUEST_E2E, 2.0)
+    status, payload, _ = metrics_http_response("/metrics?window=3")
+    assert status == 200
+    assert 'window_seconds{window="3",' in payload.decode()
+
+
+def test_slo_evaluation_does_not_materialize_metrics():
+    """/slo on a process that never served (the registry leader) must
+    not create zero-count serving series as a read side effect."""
+    reg = MetricsRegistry(window_interval_s=0.25, window_shards=8)
+    v = SLOEngine(registry=reg).verdict()
+    assert v["ok"]                          # vacuous: no data, no burn
+    assert all(w.get("no_window") for o in v["objectives"]
+               for w in o["windows"])
+    assert reg.export_state() == {"counters": {}, "timings": {},
+                                  "gauges": {}, "hists": {}}
+
+
+# ------------------------------------------------------- tail sampling
+def test_tail_sampler_direct_keep_drop_is_deterministic():
+    """Same ids, same thresholds -> same keep/drop, twice over: the slow
+    root's whole tree is promoted, the fast trace vanishes, and a
+    head-sampled trace coexists untouched."""
+    ids = [f"trace-{i}" for i in range(2000)]
+    unsampled = [t for t in ids if not head_sampled(t, 0.01)]
+    sampled = [t for t in ids if head_sampled(t, 0.01)]
+    assert len(unsampled) >= 2 and sampled
+    for _ in range(2):   # determinism: the second run repeats the first
+        tr = Tracer(sample=0.01, tail_latency_ms=40.0)
+        slow = tr.start_span("req", parent=None, trace_id=unsampled[0])
+        with tr.use(slow):
+            tr.record("req.child", duration_ms=5.0)
+        time.sleep(0.06)
+        slow.finish(status=200)
+        fast = tr.start_span("req", parent=None, trace_id=unsampled[1])
+        with tr.use(fast):
+            tr.record("req.child", duration_ms=1.0)
+        fast.finish(status=200)
+        head = tr.start_span("req", parent=None, trace_id=sampled[0])
+        head.finish(status=200)
+
+        traces = {s["trace_id"] for s in tr.finished()}
+        assert traces == {unsampled[0], sampled[0]}
+        kept = [s for s in tr.finished() if s["trace_id"] == unsampled[0]]
+        assert {s["name"] for s in kept} == {"req", "req.child"}
+        root = [s for s in kept if s["name"] == "req"][0]
+        assert root["attrs"]["tail"] is True
+        st = tr.stats()
+        assert st["tail_kept"] == 1
+        assert st["tail_dropped"] == 2           # fast root + its child
+        assert st["tail_pending"] == 0
+
+
+def test_tail_sampler_keeps_errors_and_5xx():
+    tr = Tracer(sample=0.0, tail_latency_ms=10_000.0)
+    ids = [f"err-{i}" for i in range(50)]
+    err = tr.start_span("req", parent=None, trace_id=ids[0])
+    err.finish(error="ValueError")
+    bad = tr.start_span("req", parent=None, trace_id=ids[1])
+    bad.finish(status=502)
+    ok = tr.start_span("req", parent=None, trace_id=ids[2])
+    ok.finish(status=200)
+    assert {s["trace_id"] for s in tr.finished()} == {ids[0], ids[1]}
+
+
+def test_tail_pending_buffer_evicts_oldest_deterministically():
+    tr = Tracer(sample=0.0, tail_latency_ms=5.0)
+    tr.configure(tail_pending=4)
+    roots = [tr.start_span("req", parent=None, trace_id=f"evict-{i}")
+             for i in range(6)]
+    # registering trace 4 evicted trace 0, trace 5 evicted trace 1
+    assert tr.stats()["tail_evicted"] == 2
+    time.sleep(0.01)
+    for r in roots:
+        r.finish(status=200)
+    # evicted traces' late roots are tombstoned, not leaked to the ring
+    kept = {s["trace_id"] for s in tr.finished()}
+    assert "evict-0" not in kept and "evict-1" not in kept
+    assert kept == {f"evict-{i}" for i in range(2, 6)}
+
+
+def test_tail_discarded_trace_straggler_child_does_not_leak():
+    """'Discarded wholesale' covers stragglers: a child that finishes
+    AFTER its fast root was dropped is tombstoned, not ring-appended."""
+    tr = Tracer(sample=0.0, tail_latency_ms=10_000.0)
+    root = tr.start_span("req", parent=None, trace_id="straggle-1")
+    late_child = tr.start_span("req.child", parent=root.context)
+    root.finish(status=200)            # fast + clean -> discarded
+    late_child.finish()                # finishes after the verdict
+    assert tr.finished() == []
+    assert tr.stats()["tail_dropped"] == 2
+    # and the dead trace does not resurrect header injection
+    with tr.use(root):
+        assert tr.inject({}) == {}
+
+
+def test_tail_tentative_trace_does_not_inject_headers():
+    tr = Tracer(sample=0.0, tail_latency_ms=50.0)
+    sp = tr.start_span("req", parent=None, trace_id="tentative-1")
+    with tr.use(sp):
+        assert tr.inject({}) == {}    # fate undecided: nothing propagates
+    sp.finish(status=200)
+
+
+@pytest.mark.parametrize("transport", ["selector", "threading"])
+def test_tail_capture_through_serving(tail_tracer, transport):
+    """End to end on both transports at 0% head sampling: the slow
+    request's FULL span tree (ingress root + worker transform child) is
+    in the ring; the fast request left nothing."""
+    def transform(bodies):
+        out = []
+        for b in bodies:
+            d = json.loads(b)
+            if d.get("slow"):
+                time.sleep(0.25)      # >> the fixture's 150ms threshold
+            out.append({"echo": d["x"]})
+        return out
+
+    server, query = _serving(transform, transport=transport)
+    try:
+        resp_fast, _ = _post(server.address, {"x": 1})
+        resp_slow, _ = _post(server.address, {"x": 2, "slow": True})
+        fast_id = resp_fast.headers["X-Request-Id"]
+        slow_id = resp_slow.headers["X-Request-Id"]
+        time.sleep(0.1)
+        spans = tail_tracer.finished()
+        slow_tree = [s for s in spans if s["trace_id"] == slow_id]
+        assert {s["name"] for s in slow_tree} >= {
+            "serving.request", "serving.partition.transform"}
+        root = [s for s in slow_tree
+                if s["name"] == "serving.request"][0]
+        assert root["attrs"]["tail"] is True
+        assert root["attrs"]["status"] == 200
+        assert not any(s["trace_id"] == fast_id for s in spans)
+    finally:
+        query.stop()
+        server.stop()
+
+
+# ------------------------------------------------------------ SLO engine
+def test_slo_verdict_flips_under_injected_latency_fault(fast_windows):
+    """The acceptance flip: a clean window is ok; after a seeded
+    FaultInjector delay fault pushes every request over the threshold,
+    the same objective reports burning with burn rate >> 1."""
+    objectives = [Objective(name="serving.e2e.p99", kind=tslo.LATENCY,
+                            metric=tnames.SERVING_REQUEST_E2E,
+                            threshold_ms=20.0, quantile=99.0,
+                            window_s=8.0)]
+    engine = SLOEngine(objectives, registry=fast_windows)
+    for _ in range(200):
+        fast_windows.observe_ms(tnames.SERVING_REQUEST_E2E, 1.0)
+    clean = engine.verdict()
+    assert clean["ok"] and not clean["burning"]
+    assert clean["objectives"][0]["windows"][0]["violations"] == 0
+
+    fast_windows.reset("serving.")
+    inj = FaultInjector(seed=11, rules=[
+        {"site": "serving.worker", "kind": "delay",
+         "param": 0.05, "prob": 1.0}])
+    server, query = _serving(faults=inj)
+    try:
+        for i in range(6):
+            _post(server.address, {"x": i})
+        e2e = fast_windows.histogram(tnames.SERVING_REQUEST_E2E)
+        deadline = time.monotonic() + 5.0
+        while e2e.count < 6 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        burned = engine.verdict()
+        assert not burned["ok"] and burned["burning"]
+        w = burned["objectives"][0]["windows"][0]
+        assert w["violations"] == w["count"] == 6
+        assert w["burn_rate"] > 10.0
+        assert w["value_ms"] >= 50.0
+        # the HTTP mount serves the same verdict machine-readably
+        tslo.configure(objectives)
+        try:
+            http_verdict = _get_json(server.address + "/slo")
+            assert not http_verdict["ok"]
+        finally:
+            tslo.configure(None)
+    finally:
+        query.stop()
+        server.stop()
+
+
+def test_slo_error_rate_objective_counts_5xx(fast_windows):
+    """Shed 503s burn the error budget: with max_queue=1 and no worker
+    draining, bursts shed — serving.request.{total,errors} feed the
+    error-rate objective."""
+    from mmlspark_tpu.io.serving import ServingServer
+    engine = SLOEngine([Objective(
+        name="serving.error_rate", kind=tslo.ERROR_RATE,
+        metric=tnames.SERVING_REQUEST_ERRORS,
+        total_metric=tnames.SERVING_REQUEST_TOTAL,
+        budget=0.01, window_s=8.0)], registry=fast_windows)
+    # no worker drains the queue: the first request expires to 504, the
+    # rest hit the full queue and shed 503 — every flavor of 5xx burns
+    server = ServingServer(num_partitions=1, max_queue=1,
+                           reply_timeout=0.3).start()
+    try:
+        codes = []
+        for i in range(4):
+            try:
+                _post(server.address, {"x": i}, timeout=15)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+        assert sorted(set(codes)) == [503, 504] and len(codes) == 4
+        v = engine.verdict()
+        w = v["objectives"][0]["windows"][0]
+        assert w["total"] == 4 and w["errors"] == 4
+        assert w["burn_rate"] > 10.0
+        assert not v["ok"]
+    finally:
+        server.stop(drain=False)
+
+
+def test_merge_verdicts_sums_counts_and_recomputes_burns():
+    def verdict(count, violations):
+        return {"objectives": [{
+            "objective": {"name": "o", "kind": tslo.LATENCY,
+                          "quantile": 99.0, "budget": 0.01},
+            "windows": [{"window_s": 60.0, "count": count,
+                         "violations": violations, "rate": 0.0,
+                         "burn_rate": 0.0, "value_ms": float(violations)}],
+            "ok": True, "burning": False}],
+            "ok": True, "burning": False, "workers": 1}
+
+    # worker A burns 2x (2% over threshold vs 1% allowed), worker B 0x
+    # on the same traffic volume: fleet burn is exactly 1x — averaging
+    # the workers' burn rates happens to agree HERE, but the sums are
+    # what stay exact when traffic is uneven (asserted below)
+    merged = merge_verdicts([verdict(100, 2), verdict(100, 0)])
+    w = merged["objectives"][0]["windows"][0]
+    assert w["count"] == 200 and w["violations"] == 2
+    assert w["burn_rate"] == pytest.approx(1.0)
+    assert w["value_ms_max"] == 2.0
+    assert merged["workers"] == 2
+    # uneven traffic: 10 requests all violating on a tiny worker vs
+    # 990 clean on a big one -> fleet rate 1%, burn 1.0; the average of
+    # per-worker burns (100x and 0x) would report 50x
+    merged = merge_verdicts([verdict(10, 10), verdict(990, 0)])
+    w = merged["objectives"][0]["windows"][0]
+    assert w["burn_rate"] == pytest.approx(1.0)
+    assert merge_verdicts([]) is None
+
+
+def test_scrape_cluster_merges_windows_and_slo(fast_windows):
+    """Fleet scrape with window= and slo=True: windowed histogram counts
+    sum across workers (both expose this process's registry -> exactly
+    2x) and the merged verdict sums worker counts."""
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    reg = ServiceRegistry().start()
+    s1, q1 = _serving()
+    s2, q2 = _serving()
+    tslo.configure([Objective(name="serving.e2e.p99", kind=tslo.LATENCY,
+                              metric=tnames.SERVING_REQUEST_E2E,
+                              threshold_ms=10_000.0, window_s=8.0)])
+    try:
+        for name, s in (("winscrape_a", s1), ("winscrape_b", s2)):
+            host, port = s._httpd.server_address[:2]
+            report_server_to_registry(reg.address, name, host, port)
+        for i in range(5):
+            _post(s1.address, {"x": i})
+        e2e = fast_windows.histogram(tnames.SERVING_REQUEST_E2E)
+        deadline = time.monotonic() + 5.0
+        while e2e.count < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        snap = scrape_cluster(reg.address, window=8.0, slo=True)
+        assert snap.merged["telemetry.scrape.workers"] == 2
+        assert snap.merged["telemetry.scrape.window_s"] == pytest.approx(8.0)
+        assert snap.merged["serving.request.e2e.count"] == 10
+        assert snap.merged["serving.request.total"] == 10
+        assert snap.slo["workers"] == 2
+        w = snap.slo["objectives"][0]["windows"][0]
+        assert w["count"] == 10 and snap.slo["ok"]
+    finally:
+        tslo.configure(None)
+        q1.stop()
+        q2.stop()
+        s1.stop()
+        s2.stop()
+        reg.stop()
+
+
+# --------------------------------------------------- self-scrape exclusion
+@pytest.mark.parametrize("transport", ["selector", "threading"])
+def test_exposition_paths_do_not_inflate_request_metrics(
+        fast_windows, transport):
+    server, query = _serving(transport=transport)
+    try:
+        url = server.address
+        for path in ("/metrics", "/metrics.json", "/metrics.json?window=5",
+                     "/slo"):
+            urllib.request.urlopen(url + path, timeout=15).read()
+        # a POSTing poller is excluded too (the threading transport used
+        # to enqueue any POST; the selector transport is method-agnostic)
+        urllib.request.urlopen(urllib.request.Request(
+            url + "/metrics.json", data=b"{}"), timeout=15).read()
+        snap = reliability_metrics.snapshot()
+        assert snap.get(tnames.SERVING_REQUEST_TOTAL, 0) == 0
+        assert snap.get(tnames.SERVING_REQUEST_ERRORS, 0) == 0
+        assert snap.get("serving.request.e2e.count", 0) == 0
+        assert snap.get(tnames.SERVING_QUEUE_DEPTH, 0) == 0
+        # one real request counts exactly once
+        _post(url, {"x": 1})
+        assert reliability_metrics.get(tnames.SERVING_REQUEST_TOTAL) == 1
+    finally:
+        query.stop()
+        server.stop()
+
+
+# ------------------------------------------------------------- poller
+def test_telemetry_poller_retains_bounded_series(fast_windows, tmp_path):
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    reg = ServiceRegistry().start()
+    server, query = _serving()
+    try:
+        host, port = server._httpd.server_address[:2]
+        report_server_to_registry(reg.address, "polled", host, port)
+        for i in range(3):
+            _post(server.address, {"x": i})
+        poller = TelemetryPoller(reg.address, interval_s=0.1,
+                                 window_s=5.0, history=4).start()
+        # wait until the poller has taken MORE polls than the ring holds,
+        # so the bounded-retention assert below proves a real wrap
+        deadline = time.monotonic() + 10.0
+        while (reliability_metrics.get(tnames.TELEMETRY_POLL_SAMPLES) < 6
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        poller.stop()
+        samples = poller.samples()
+        assert len(samples) == 4                  # bounded retention
+        assert all(s["workers"] == 1 for s in samples)
+        series = poller.series("serving.request.total")
+        assert series and all(v == 3 for _, v in series)
+        times = [t for t, _ in series]
+        assert times == sorted(times)
+        assert poller.latest()["slo"] is not None
+        path = str(tmp_path / "fleet.jsonl")
+        assert poller.export_jsonl(path) == 4
+        with open(path) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        assert len(lines) == 4
+        assert lines[-1]["metrics"]["serving.request.total"] == 3
+        assert not poller.stats()["running"]
+    finally:
+        query.stop()
+        server.stop()
+        reg.stop()
+
+
+def test_poller_survives_scrape_failures_and_restarts(fast_windows):
+    poller = TelemetryPoller("http://127.0.0.1:9", interval_s=0.05,
+                             history=4, timeout=0.2).start()
+    time.sleep(0.3)
+    poller.stop()
+    assert poller.samples() == []
+    errs = reliability_metrics.get(tnames.TELEMETRY_POLL_ERRORS)
+    assert errs >= 1
+    # a stopped poller restarts and KEEPS polling (the stop event must
+    # be re-armed, or the restarted loop exits after one round)
+    poller.start()
+    deadline = time.monotonic() + 10.0
+    while (reliability_metrics.get(tnames.TELEMETRY_POLL_ERRORS)
+           < errs + 2 and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert poller.stats()["running"]
+    poller.stop()
+    assert reliability_metrics.get(tnames.TELEMETRY_POLL_ERRORS) >= errs + 2
