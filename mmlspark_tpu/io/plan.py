@@ -37,6 +37,8 @@ import hashlib
 import json
 import math
 import threading
+import time
+from collections import OrderedDict
 from typing import Callable, Sequence
 
 import numpy as np
@@ -47,6 +49,7 @@ from ..reliability.metrics import reliability_metrics
 from ..stages.batching import pad_rows_to_bucket, shape_bucket
 from ..telemetry.spans import get_tracer
 from ..telemetry import names as tnames
+from ..telemetry import perf as tperf
 from .serving import Reply, _jsonable
 
 
@@ -120,7 +123,7 @@ class ServingTransform:
 
     def __init__(self, model, input_cols: Sequence[str],
                  output_col: str = "prediction", max_bucket: int = 4096,
-                 metrics=None):
+                 metrics=None, max_plans: int = 64):
         # a single-stage PipelineModel serves through its one stage — the
         # wrapper adds nothing and would hide the stage's serving kernel
         stages = (model.get_or_default("stages")
@@ -138,7 +141,16 @@ class ServingTransform:
         self._kernel = (kernel_of(output_col)
                         if kernel_of is not None and len(self.input_cols) == 1
                         else None)
-        self._plans: dict = {}
+        # bounded LRU: power-of-two bucketing keeps the steady-state key
+        # count logarithmic, but a cache shared across hot-swapped model
+        # versions (ROADMAP item 5) or fed adversarial batch sizes must
+        # not grow without bound. Eviction DRAINS, never invalidates:
+        # plans are stateless (assemble, run) closures, so a worker
+        # mid-batch on an evicted plan finishes on the object it holds —
+        # the evicted key just rebuilds on next use (and the rebuild is
+        # what `plan.recompiles` makes visible).
+        self._plans: OrderedDict = OrderedDict()
+        self.max_plans = max(int(max_plans), 1)
         self._lock = threading.Lock()
         # single-flight plan construction: key -> Event the builder sets
         # once the plan (or its failure) lands; concurrent missers wait
@@ -146,6 +158,7 @@ class ServingTransform:
         self._building: dict = {}
         self._hits = 0
         self._misses = 0
+        self._evictions = 0
         # reply framing serialized once: the write path appends only the
         # per-row value between these fragments
         self._prefix = ('{"%s": ' % output_col).encode()
@@ -213,6 +226,7 @@ class ServingTransform:
                 plan = self._plans.get(key)
                 if plan is not None:
                     self._hits += 1
+                    self._plans.move_to_end(key)   # LRU touch
                     wait_for = None
                 else:
                     wait_for = self._building.get(key)
@@ -225,23 +239,49 @@ class ServingTransform:
             if wait_for is not None:
                 wait_for.wait()   # builder is compiling; loop re-checks
                 continue
+            t0 = time.perf_counter()
             try:
                 built = self._build_plan(bucket)
             except BaseException:
                 with self._lock:
                     self._building.pop(key).set()   # wake waiters to retry
                 raise
+            build_s = time.perf_counter() - t0
+            evicted = 0
             with self._lock:
                 self._plans[key] = built
+                self._plans.move_to_end(key)
+                while len(self._plans) > self.max_plans:
+                    self._plans.popitem(last=False)   # drained, not closed
+                    self._evictions += 1
+                    evicted += 1
                 self._misses += 1
                 self._building.pop(key).set()
             self._metrics.inc(tnames.SERVING_PLAN_MISSES)
+            if evicted:
+                self._metrics.inc(tnames.SERVING_PLAN_EVICTIONS, evicted)
+            # compile telemetry (telemetry/perf.py): plan.compile
+            # span/histogram, per-(fingerprint, bucket) counts/seconds,
+            # and the recompile detector — a key built AGAIN (eviction
+            # pressure, or bucketing gone wrong) counts plan.recompiles,
+            # which steady-state serving pins to zero
+            tperf.record_plan_compile(
+                self.fingerprint, bucket, build_s,
+                analysis={"rows_bucket": bucket,
+                          "input_cols": len(self.input_cols),
+                          "kind": ("host-kernel" if self._kernel is not None
+                                   else "table-transform")},
+                label=type(self.model).__name__,
+                registry=(None if self._metrics is reliability_metrics
+                          else self._metrics))
             return built
 
     def stats(self) -> dict:
         with self._lock:
             return {"hits": self._hits, "misses": self._misses,
-                    "buckets": len(self._plans)}
+                    "buckets": len(self._plans),
+                    "evictions": self._evictions,
+                    "capacity": self.max_plans}
 
     # -- the transform -------------------------------------------------------
     def __call__(self, bodies: Sequence[bytes]) -> list:
@@ -313,8 +353,10 @@ class ServingTransform:
 
 def compile_serving_transform(model, input_cols: Sequence[str],
                               output_col: str = "prediction",
-                              max_bucket: int = 4096) -> ServingTransform:
+                              max_bucket: int = 4096,
+                              max_plans: int = 64) -> ServingTransform:
     """Build the compiled serving transform for a fitted model/pipeline.
-    See module docstring; `serve_pipeline(fast_path=True)` calls this."""
+    See module docstring; `serve_pipeline(fast_path=True)` calls this.
+    `max_plans` bounds the LRU plan cache (`serving.plan.evictions`)."""
     return ServingTransform(model, input_cols, output_col,
-                            max_bucket=max_bucket)
+                            max_bucket=max_bucket, max_plans=max_plans)

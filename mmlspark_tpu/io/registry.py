@@ -87,10 +87,11 @@ class _RegistryHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         reg: "ServiceRegistry" = self.server.registry  # type: ignore
         path = self.path.split("?", 1)[0]
-        if path in ("/metrics", "/metrics.json", "/slo"):
+        if path in ("/metrics", "/metrics.json", "/slo", "/debug/bundle"):
             # full path rides through so ?window= reaches the handler;
             # /slo exposes the leader's own objectives (worker verdicts
-            # come from scrape_cluster(slo=True))
+            # come from scrape_cluster(slo=True)); /debug/bundle dumps
+            # the leader's flight-recorder bundle on demand
             from ..telemetry.exposition import metrics_http_response
             status, payload, ctype = metrics_http_response(self.path)
             self.send_response(status)

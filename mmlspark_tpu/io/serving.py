@@ -204,8 +204,10 @@ _REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
 # Exposition endpoints answered at ingress on BOTH transports: never
 # enqueued to partition workers, never shed during drain, and excluded
 # from serving.request.* metrics (a self-scrape must not move the SLO
-# it reports on).
-EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo")
+# it reports on). /debug/bundle is the on-demand flight-recorder dump
+# (telemetry/perf.py) — reachable even on a server whose workers are
+# wedged, which is exactly when you want the bundle.
+EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo", "/debug/bundle")
 
 # Ingress bounds: a header block or body beyond these is rejected and the
 # connection closed — the single-threaded loop must never be wedged (or its
@@ -798,10 +800,13 @@ class ServingServer:
         if batch:
             now = time.perf_counter()
             # one registry lookup per batch (NOT per request); the handle is
-            # never cached across calls so tests' reset() stays effective
+            # never cached across calls so tests' reset() stays effective.
+            # trace_id leaves a per-bucket exemplar: the request id IS the
+            # trace id, so a slow queue bucket points at a followable trace
             hist = reliability_metrics.histogram(tnames.SERVING_REQUEST_QUEUE)
             for r in batch:
-                hist.observe_ms((now - r.t_enqueue) * 1000.0)
+                hist.observe_ms((now - r.t_enqueue) * 1000.0,
+                                trace_id=r.id)
         with self._lock:
             self._history[(pid, epoch)] = batch
         return epoch, batch
@@ -1026,7 +1031,9 @@ class ServingQuery:
                                        (t2 - t1) * 1000.0)
         hist = reliability_metrics.histogram(tnames.SERVING_REQUEST_E2E)
         for r in live:
-            hist.observe_ms((t2 - r.t_enqueue) * 1000.0)
+            # exemplar: a burning e2e p99 bucket resolves to this request
+            # id == trace id == the tail-captured span tree (perf.py)
+            hist.observe_ms((t2 - r.t_enqueue) * 1000.0, trace_id=r.id)
 
     def stop(self):
         self._stop.set()

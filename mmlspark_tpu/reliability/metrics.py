@@ -94,10 +94,19 @@ class Histogram:
     `percentile(p)` returns the geometric midpoint of the bucket holding
     the p-th sample, clamped to the observed min/max — bounded relative
     error regardless of how many samples arrive (the reason over a raw
-    sample list: a day of traffic must not grow memory)."""
+    sample list: a day of traffic must not grow memory).
+
+    **Trace exemplars**: an observation that carries a `trace_id` leaves
+    a last-per-bucket exemplar `(trace_id, ms, wall_ts)` — the link from
+    a burning p99 bucket back to the tail-captured span tree of a request
+    that landed in it. Bounded by construction (at most one slot per
+    bucket, 256 total) and cheap by construction (the lock-held cost is
+    one dict slot write; windowed shards carry NO exemplars). Callers
+    that have no per-observation identity simply omit `trace_id` and pay
+    nothing."""
 
     __slots__ = ("name", "_counts", "_count", "_sum_ms", "_min_ms",
-                 "_max_ms", "_lock", "window")
+                 "_max_ms", "_lock", "window", "_exemplars")
 
     def __init__(self, name: str):
         self.name = name
@@ -111,11 +120,19 @@ class Histogram:
         # registry: cumulative and windowed views share ONE bisect per
         # observation (the shards reuse this histogram's bucket index)
         self.window = None
+        self._exemplars: dict = {}   # bucket idx -> (trace_id, ms, ts)
 
-    def observe_ms(self, ms: float) -> None:
+    def observe_ms(self, ms: float, trace_id: Optional[str] = None) -> None:
         if ms < 0.0:
             ms = 0.0
         idx = bisect_right(_HIST_BOUNDS, ms)
+        if trace_id is not None:
+            # timestamped OUTSIDE the lock (one perf_counter read); only
+            # exemplar-carrying observations pay it
+            from ..telemetry.spans import wall_now
+            ex = (trace_id, ms, wall_now())
+        else:
+            ex = None
         with self._lock:
             self._counts[idx] += 1
             self._count += 1
@@ -124,12 +141,20 @@ class Histogram:
                 self._min_ms = ms
             if ms > self._max_ms:
                 self._max_ms = ms
+            if ex is not None:
+                self._exemplars[idx] = ex   # last writer wins, one slot
         w = self.window
         if w is not None:
             w.observe_idx(idx, ms)
 
     def observe(self, seconds: float) -> None:
         self.observe_ms(seconds * 1000.0)
+
+    def exemplars(self) -> dict:
+        """{bucket_index: (trace_id, ms, wall_ts)} — the last exemplar
+        per bucket."""
+        with self._lock:
+            return dict(self._exemplars)
 
     @property
     def count(self) -> int:
@@ -176,12 +201,17 @@ class Histogram:
     def state(self) -> dict:
         """Raw bucket counts + aggregates — the mergeable form. Every
         Histogram shares the module-level bounds, so merging two states is
-        an elementwise count sum."""
+        an elementwise count sum. Exemplars ride along (JSON keys are
+        strings) when any exist; merges keep the newest per bucket."""
         with self._lock:
-            return {"counts": list(self._counts), "count": self._count,
-                    "sum_ms": self._sum_ms,
-                    "min_ms": self._min_ms if self._count else None,
-                    "max_ms": self._max_ms}
+            out = {"counts": list(self._counts), "count": self._count,
+                   "sum_ms": self._sum_ms,
+                   "min_ms": self._min_ms if self._count else None,
+                   "max_ms": self._max_ms}
+            if self._exemplars:
+                out["exemplars"] = {str(i): list(e)
+                                    for i, e in self._exemplars.items()}
+        return out
 
     @classmethod
     def from_state(cls, name: str, state: dict) -> "Histogram":
@@ -197,6 +227,8 @@ class Histogram:
         mn = state.get("min_ms")
         h._min_ms = float("inf") if mn is None else float(mn)
         h._max_ms = float(state.get("max_ms", 0.0))
+        for i, e in (state.get("exemplars") or {}).items():
+            h._exemplars[int(i)] = tuple(e)
         return h
 
     def __repr__(self):
@@ -311,8 +343,9 @@ class MetricsRegistry:
         with self._lock:
             return self._hists.get(name)
 
-    def observe_ms(self, name: str, ms: float) -> None:
-        self.histogram(name).observe_ms(ms)
+    def observe_ms(self, name: str, ms: float,
+                   trace_id: Optional[str] = None) -> None:
+        self.histogram(name).observe_ms(ms, trace_id=trace_id)
 
     def percentile(self, name: str, p: float) -> float:
         with self._lock:

@@ -30,6 +30,10 @@ Pillars:
 - **Retention** (`telemetry.poller`): `TelemetryPoller` polls the fleet
   on an interval and keeps a bounded JSONL-exportable series — the
   autotuner/control-plane data substrate.
+- **Performance** (`telemetry.perf`): compile/cost telemetry with a
+  recompile detector, device/host memory gauges sampled on every
+  scrape, per-bucket trace exemplars on histograms, and the
+  burn-triggered flight recorder (`GET /debug/bundle`).
 - **Hooks**: serving request path, `data.DevicePrefetcher`,
   `TrainingSupervisor` step/checkpoint lifecycle, `fit_booster`
   iterations, `utils.tracing.trace` device profiles (stamped with the
@@ -61,6 +65,13 @@ _LAZY_NAMES = {
     "Objective": "slo", "SLOEngine": "slo", "default_objectives": "slo",
     "merge_verdicts": "slo",
     "TelemetryPoller": "poller",
+    "CompileLog": "perf", "FlightRecorder": "perf",
+    "compile_with_analysis": "perf", "executable_analysis": "perf",
+    "record_plan_compile": "perf", "get_compile_log": "perf",
+    "compile_stats": "perf", "hbm_utilization": "perf",
+    "sample_resource_gauges": "perf", "sample_resource_stats": "perf",
+    "get_flight_recorder": "perf", "configure_flight_recorder": "perf",
+    "trigger_bundle": "perf",
 }
 
 
@@ -81,4 +92,9 @@ __all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
            "PROM_CONTENT_TYPE",
            "WindowedHistogram", "WindowedCounter",
            "Objective", "SLOEngine", "default_objectives", "merge_verdicts",
-           "TelemetryPoller"]
+           "TelemetryPoller",
+           "CompileLog", "FlightRecorder", "compile_with_analysis",
+           "executable_analysis", "record_plan_compile", "get_compile_log",
+           "compile_stats", "hbm_utilization", "sample_resource_gauges",
+           "sample_resource_stats", "get_flight_recorder",
+           "configure_flight_recorder", "trigger_bundle"]

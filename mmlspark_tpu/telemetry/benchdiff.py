@@ -1,0 +1,192 @@
+"""Bench-trajectory differ: per-metric deltas across BENCH round files.
+
+The driver records one ``BENCH_rNN.json`` per round (a wrapper object
+whose ``parsed`` field holds the headline JSON line and whose ``tail``
+holds every JSON line the bench printed), but nothing in the tree ever
+*compared* rounds — a 20% regression between r4 and r5 was only visible
+to a human reading two files. This is the missing tool:
+
+    python -m mmlspark_tpu.telemetry.benchdiff BENCH_r*.json
+    python -m mmlspark_tpu.telemetry.benchdiff --threshold 0.15 BENCH_r*.json
+
+prints, per metric, the value trajectory across rounds and the
+last-vs-previous delta, and — with ``--threshold`` set — exits nonzero
+when any metric regressed by more than that fraction (higher-is-better
+by default; flag lower-is-better metrics with ``--lower-better``, e.g.
+elapsed-seconds metrics). Accepts the driver wrapper format, raw bench
+JSONL (one ``{"metric": ...}`` object per line), or a single JSON
+object; rounds order by the wrapper's ``n`` when present, else by
+filename.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import List, Optional, Tuple
+
+_DIGITS = re.compile(r"(\d+)")
+
+
+def _natural_key(path: str) -> tuple:
+    """Filename sort key with digit runs compared numerically, so
+    BENCH_r10 orders after BENCH_r2 (lexicographic sorting would put it
+    first and make last-vs-prev compare the wrong rounds)."""
+    return tuple(int(part) if part.isdigit() else part
+                 for part in _DIGITS.split(path))
+
+
+def _records_from_text(text: str) -> list:
+    """Every JSON object with a "metric" key found in `text` (whole-file
+    object, wrapper with parsed/tail, or JSONL)."""
+    text = text.strip()
+    if not text:
+        return []
+    records: list = []
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict):
+        if "metric" in obj:
+            return [obj]
+        # driver wrapper: {"n": ..., "parsed": {...}, "tail": "..."} —
+        # harvest every bench line from the tail (multi-mode runs print
+        # several), with `parsed` as the authoritative headline
+        for line in str(obj.get("tail", "")).splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "metric" in rec:
+                    records.append(rec)
+        parsed = obj.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            records = [r for r in records
+                       if r.get("metric") != parsed["metric"]]
+            records.append(parsed)
+        return records
+    # JSONL fallback
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            records.append(rec)
+    return records
+
+
+def load_round(path: str) -> Tuple[object, dict]:
+    """(sort_key, {metric: record}) for one round file."""
+    with open(path) as f:
+        text = f.read()
+    sort_key: object = path
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict) and isinstance(obj.get("n"), int):
+            sort_key = obj["n"]
+    except ValueError:
+        pass
+    by_metric = {}
+    for rec in _records_from_text(text):
+        by_metric[rec["metric"]] = rec   # last line wins, like the driver
+    return sort_key, by_metric
+
+
+def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
+                threshold: Optional[float] = None,
+                lower_better: Tuple[str, ...] = ()) -> Tuple[list, list]:
+    """(report_lines, regressions) across rounds (already ordered).
+    A regression compares the LAST round's value against the most recent
+    earlier round that carries the metric."""
+    order: dict = {}   # metric -> [(label, value)] — dict keeps insertion order
+    for label, by_metric in rounds:
+        for metric, rec in by_metric.items():
+            v = rec.get(key)
+            if isinstance(v, (int, float)):
+                order.setdefault(metric, []).append((label, float(v)))
+    lines: list = []
+    regressions: list = []
+    for metric, series in order.items():
+        traj = " -> ".join(f"{label}:{value:g}" for label, value in series)
+        if len(series) < 2:
+            lines.append(f"{metric} [{key}]: {traj}  (single round)")
+            continue
+        (_, prev), (_, last) = series[-2], series[-1]
+        if last == prev:
+            delta = 0.0   # unchanged is unchanged, even from a 0 baseline
+        elif prev:
+            delta = (last - prev) / abs(prev)
+        else:
+            delta = float("inf")
+        lines.append(f"{metric} [{key}]: {traj}  last-vs-prev "
+                     f"{delta:+.1%}")
+        if threshold is not None:
+            drop = -delta if metric not in lower_better else delta
+            if drop > threshold:
+                regressions.append(
+                    f"{metric}: {prev:g} -> {last:g} "
+                    f"({delta:+.1%}, threshold {threshold:.0%}"
+                    f"{', lower-better' if metric in lower_better else ''})")
+    return lines, regressions
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mmlspark_tpu.telemetry.benchdiff",
+        description="Per-metric deltas across bench round files; "
+                    "nonzero exit on regression beyond --threshold.")
+    parser.add_argument("files", nargs="+", help="BENCH_r*.json files")
+    parser.add_argument("--key", default="value",
+                        help="numeric field to diff (default: value)")
+    parser.add_argument("--threshold", type=float, default=None,
+                        help="fail when a metric regresses by more than "
+                             "this fraction (e.g. 0.15 = 15%%)")
+    parser.add_argument("--lower-better", action="append", default=[],
+                        metavar="METRIC",
+                        help="metric where a DROP is an improvement "
+                             "(repeatable)")
+    args = parser.parse_args(argv)
+    rounds = []
+    for path in args.files:
+        try:
+            sort_key, by_metric = load_round(path)
+        except (OSError, ValueError) as e:
+            # ValueError covers UnicodeDecodeError: a stray binary file
+            # in the glob is "unreadable input" (exit 2), not a crash
+            print(f"benchdiff: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        rounds.append((sort_key, path, by_metric))
+    # wrapper `n` orders rounds when every file has one; natural
+    # filename order otherwise (mixed keys are not comparable in py3)
+    if all(isinstance(k, int) for k, _, _ in rounds):
+        rounds.sort(key=lambda r: r[0])
+    else:
+        rounds.sort(key=lambda r: _natural_key(r[1]))
+    labeled = [(f"r{k:02d}" if isinstance(k, int) else path, by)
+               for k, path, by in rounds]
+    lines, regressions = diff_rounds(
+        labeled, key=args.key, threshold=args.threshold,
+        lower_better=tuple(args.lower_better))
+    for line in lines:
+        print(line)
+    if not lines:
+        print("benchdiff: no numeric records found", file=sys.stderr)
+        return 2
+    if regressions:
+        print(f"\nREGRESSIONS ({len(regressions)}):", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

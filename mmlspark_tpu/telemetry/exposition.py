@@ -35,6 +35,11 @@ from ..reliability.metrics import (Histogram, MetricsRegistry,
                                    histogram_bounds_ms, reliability_metrics)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# exemplars are only legal in the OpenMetrics format — a 0.0.4 parser
+# reads the trailing `# {...}` as a malformed timestamp and rejects the
+# whole scrape — so /metrics?exemplars=1 switches format AND declares it
+OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
 
 # windows rendered as Prometheus gauges on GET /metrics (seconds); the
 # JSON form takes any ?window= the ring covers
@@ -57,28 +62,41 @@ def _fmt(v: float) -> str:
 
 
 def render_prometheus(registry=None, state: Optional[dict] = None,
-                      windows: Optional[tuple] = None) -> str:
+                      windows: Optional[tuple] = None,
+                      exemplars: bool = False) -> str:
     """Render a registry (default: the process-wide `reliability_metrics`)
     or a raw `export_state()` dict as Prometheus text. `windows` selects
     the lookbacks for the windowed quantile gauges (default
-    `PROM_WINDOWS_S`; only a live registry carries shards to render)."""
+    `PROM_WINDOWS_S`; only a live registry carries shards to render).
+    `exemplars=True` appends OpenMetrics exemplar suffixes to histogram
+    bucket lines — the caller must then serve the output under
+    `OPENMETRICS_CONTENT_TYPE` with an `# EOF` trailer, never as 0.0.4
+    (which cannot carry them)."""
     if state is None:
         reg = registry if registry is not None else reliability_metrics
         state = reg.export_state()
     bounds = histogram_bounds_ms()
     lines: list = []
+    # OpenMetrics (the exemplar mode) names the FAMILY without the
+    # `_total` suffix while the counter sample keeps it; 0.0.4 metadata
+    # names the sample itself. Strict OM parsers reject the 0.0.4
+    # spelling as a name clash, so the suffix placement follows the
+    # negotiated format.
+    om = exemplars
     for name in sorted(state.get("counters", {})):
-        pn = prom_name(name) + "_total"
-        lines.append(f"# HELP {pn} {name}")
-        lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {_fmt(state['counters'][name])}")
+        pn = prom_name(name)
+        family = pn if om else pn + "_total"
+        lines.append(f"# HELP {family} {name}")
+        lines.append(f"# TYPE {family} counter")
+        lines.append(f"{pn}_total {_fmt(state['counters'][name])}")
     for name in sorted(state.get("timings", {})):
         total, count = state["timings"][name]
         pn = prom_name(name)
-        lines.append(f"# HELP {pn}_seconds_total {name} (wall-clock sink)")
-        lines.append(f"# TYPE {pn}_seconds_total counter")
+        sfx = "" if om else "_total"
+        lines.append(f"# HELP {pn}_seconds{sfx} {name} (wall-clock sink)")
+        lines.append(f"# TYPE {pn}_seconds{sfx} counter")
         lines.append(f"{pn}_seconds_total {_fmt(total)}")
-        lines.append(f"# TYPE {pn}_calls_total counter")
+        lines.append(f"# TYPE {pn}_calls{sfx} counter")
         lines.append(f"{pn}_calls_total {_fmt(count)}")
     for name in sorted(state.get("gauges", {})):
         pn = prom_name(name)
@@ -92,11 +110,13 @@ def render_prometheus(registry=None, state: Optional[dict] = None,
         lines.append(f"# TYPE {pn} histogram")
         cum = 0
         counts = h["counts"]
+        hist_ex = (h.get("exemplars") or {}) if exemplars else {}
         for i, bound_ms in enumerate(bounds):
             cum += counts[i]
             lines.append(f'{pn}_bucket{{le="{_fmt(bound_ms / 1000.0)}"}} '
-                         f"{cum}")
-        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+                         f"{cum}" + _exemplar_suffix(hist_ex, i))
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}'
+                     + _exemplar_suffix(hist_ex, len(bounds)))
         lines.append(f"{pn}_sum {_fmt(h['sum_ms'] / 1000.0)}")
         lines.append(f"{pn}_count {h['count']}")
     if registry is not None or state is None:
@@ -104,6 +124,24 @@ def render_prometheus(registry=None, state: Optional[dict] = None,
         lines.extend(_render_window_gauges(
             reg, windows if windows is not None else PROM_WINDOWS_S))
     return "\n".join(lines) + "\n"
+
+
+def _exemplar_suffix(exemplars: dict, idx: int) -> str:
+    """OpenMetrics exemplar for one bucket line: the last trace id that
+    landed in this bucket, with its value (seconds) and wall timestamp —
+    `... # {trace_id="<id>"} 0.093 1723450000.1`. Empty when the bucket
+    has none (exemplars are per-observation opt-in)."""
+    ex = exemplars.get(str(idx))
+    if ex is None:
+        ex = exemplars.get(idx)
+    if not ex:
+        return ""
+    trace_id, ms, ts = ex[0], float(ex[1]), float(ex[2])
+    # the timestamp gets millisecond precision, NOT _fmt's 9 significant
+    # digits — current epoch seconds would collapse to 10 s resolution
+    # in exponent form, useless for ordering requests in a burn window
+    return (f' # {{trace_id="{trace_id}"}} {_fmt(ms / 1000.0)}'
+            f" {ts:.3f}")
 
 
 def _render_window_gauges(reg, windows) -> list:
@@ -126,9 +164,17 @@ def _render_window_gauges(reg, windows) -> list:
                     f'{pn}_window_seconds{{window="{win}",'
                     f'quantile="{label}"}} '
                     f"{_fmt(h.percentile(q) / 1000.0)}")
+            lines.append(f"# TYPE {pn}_window_count gauge")
             lines.append(f'{pn}_window_count{{window="{win}"}} '
                          f"{h.count}")
     return lines
+
+
+def _wants_exemplars(path: str) -> bool:
+    """?exemplars=1 (or any value but 0/false) on /metrics."""
+    query = path.partition("?")[2]
+    values = urllib.parse.parse_qs(query).get("exemplars")
+    return bool(values) and values[-1].lower() not in ("0", "", "false")
 
 
 def _parse_window(path: str):
@@ -149,8 +195,9 @@ def _parse_window(path: str):
 
 def metrics_http_response(path: str, registry=None) -> tuple:
     """(status, payload_bytes, content_type) for the exposition GETs —
-    `/metrics`, `/metrics.json[?window=N]`, and `/slo` — the shared
-    handler body `ServingServer` and `ServiceRegistry` mount."""
+    `/metrics`, `/metrics.json[?window=N]`, `/slo`, and `/debug/bundle`
+    — the shared handler body `ServingServer` and `ServiceRegistry`
+    mount."""
     reg = registry if registry is not None else reliability_metrics
     try:
         base, window_s = _parse_window(path)
@@ -161,16 +208,60 @@ def metrics_http_response(path: str, registry=None) -> tuple:
         from .slo import get_engine
         return 200, json.dumps(get_engine().verdict()).encode(), \
             "application/json"
+    if base == "/debug/bundle":
+        return _bundle_response()
+    # every metrics scrape carries a FRESH memory sample: device
+    # memory_stats + host RSS land in gauges right before export, so the
+    # fleet's headroom rides next to its latency (telemetry/perf.py;
+    # guarded — a broken backend loses gauges, never the scrape)
+    try:
+        from .perf import sample_resource_gauges
+        sample_resource_gauges(reg)
+    except Exception:  # noqa: BLE001
+        pass
     if base == "/metrics.json":
         return 200, \
             json.dumps(reg.export_state(window_s=window_s)).encode(), \
             "application/json"
     # /metrics honors ?window= too: it selects the windowed-gauge
     # lookback (the cumulative series are part of the Prometheus
-    # contract and always render)
+    # contract and always render). ?exemplars=1 switches the response to
+    # OpenMetrics (exemplar suffixes + # EOF trailer + its content
+    # type); the default stays clean 0.0.4 so a stock Prometheus scrape
+    # never sees a token it cannot parse.
     windows = (window_s,) if window_s is not None else None
+    if _wants_exemplars(path):
+        text = render_prometheus(reg, windows=windows, exemplars=True)
+        return 200, (text + "# EOF\n").encode(), OPENMETRICS_CONTENT_TYPE
     return 200, render_prometheus(reg, windows=windows).encode(), \
         PROM_CONTENT_TYPE
+
+
+def _bundle_response() -> tuple:
+    """GET /debug/bundle: dump a flight-recorder bundle on demand. 503
+    when no bundle dir is configured, 429 when the rate limit suppressed
+    the dump (a scrape loop must not turn the debug endpoint into a disk
+    filler), else the bundle manifest."""
+    from .perf import get_flight_recorder
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return 503, json.dumps(
+            {"error": "flight recorder disabled — set "
+                      "MMLSPARK_TPU_BUNDLE_DIR or "
+                      "telemetry.perf.configure_flight_recorder("
+                      "bundle_dir=...)"}).encode(), "application/json"
+    try:
+        manifest = rec.dump("on-demand")
+    except Exception as e:  # noqa: BLE001 - a 500 beats a dropped scrape
+        return 500, json.dumps(
+            {"error": f"bundle write failed: {e}"}).encode(), \
+            "application/json"
+    if manifest is None:
+        return 429, json.dumps(
+            {"error": "bundle suppressed by rate limit",
+             "min_interval_s": rec.min_interval_s}).encode(), \
+            "application/json"
+    return 200, json.dumps(manifest).encode(), "application/json"
 
 
 # ---------------------------------------------------------------- merging
@@ -196,10 +287,13 @@ def merge_states(states: list) -> dict:
         for name, h in st.get("hists", {}).items():
             m = merged["hists"].get(name)
             if m is None:
-                merged["hists"][name] = {
+                merged["hists"][name] = m = {
                     "counts": list(h["counts"]), "count": h["count"],
                     "sum_ms": h["sum_ms"], "min_ms": h.get("min_ms"),
                     "max_ms": h.get("max_ms", 0.0)}
+                ex = h.get("exemplars")
+                if ex:
+                    m["exemplars"] = dict(ex)
                 continue
             m["counts"] = [a + b for a, b in zip(m["counts"], h["counts"])]
             m["count"] += h["count"]
@@ -208,6 +302,14 @@ def merge_states(states: list) -> dict:
                     if x is not None]
             m["min_ms"] = min(mins) if mins else None
             m["max_ms"] = max(m.get("max_ms", 0.0), h.get("max_ms", 0.0))
+            for idx, ex in (h.get("exemplars") or {}).items():
+                # newest exemplar per bucket wins across workers (an
+                # exemplar is a pointer, not a statistic — no sum/avg
+                # has meaning; recency keeps it actionable)
+                dst = m.setdefault("exemplars", {})
+                prev = dst.get(idx)
+                if prev is None or float(ex[2]) >= float(prev[2]):
+                    dst[idx] = list(ex)
     return merged
 
 

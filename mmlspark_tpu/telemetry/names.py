@@ -62,6 +62,11 @@ DATA_WORKER_FAILURES = "data.worker_failures"
 DATA_PREFETCH_ITEMS = "data.prefetch.items"
 DATA_PREFETCH_STALLS = "data.prefetch.stalls"
 DATA_PREFETCH_FULL = "data.prefetch.full"
+PLAN_COMPILES = "plan.compiles"
+PLAN_RECOMPILES = "plan.recompiles"
+SERVING_PLAN_EVICTIONS = "serving.plan.evictions"
+TELEMETRY_BUNDLE_DUMPS = "telemetry.bundle.dumps"
+TELEMETRY_BUNDLE_SUPPRESSED = "telemetry.bundle.suppressed"
 
 COUNTERS = {
     SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
@@ -114,6 +119,15 @@ COUNTERS = {
     DATA_PREFETCH_STALLS: "consumer arrived at an empty prefetch queue",
     DATA_PREFETCH_FULL: "feeder found the prefetch queue full (device is "
                         "the bottleneck)",
+    PLAN_COMPILES: "plan builds / AOT jit compiles recorded "
+                   "(telemetry.perf compile log)",
+    PLAN_RECOMPILES: "a (fingerprint, shape bucket) compiled AGAIN — "
+                     "steady-state serving pins this to zero",
+    SERVING_PLAN_EVICTIONS: "compiled plans evicted (LRU) from the "
+                            "bounded plan cache",
+    TELEMETRY_BUNDLE_DUMPS: "flight-recorder debug bundles written",
+    TELEMETRY_BUNDLE_SUPPRESSED: "flight-recorder triggers suppressed by "
+                                 "the rate limit",
     "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
                              "(process/thread)",
     "{breaker}.trips": "circuit-breaker trips, one counter per breaker "
@@ -126,6 +140,9 @@ SERVING_BATCH_OCCUPANCY = "serving.batch.occupancy"
 CHECKPOINT_WRITE_PENDING = "checkpoint.write.pending"
 TRAIN_RESUME_STEP = "train.resume_step"
 CLUSTER_RESUME_EPOCH = "cluster.resume_epoch"
+DEVICE_MEM_BYTES_IN_USE = "device.mem.bytes_in_use"
+DEVICE_MEM_PEAK_BYTES = "device.mem.peak_bytes"
+HOST_RSS_BYTES = "host.rss_bytes"
 
 GAUGES = {
     SERVING_QUEUE_DEPTH: "partition queue depth at last enqueue",
@@ -134,6 +151,14 @@ GAUGES = {
     CHECKPOINT_WRITE_PENDING: "async checkpoint snapshots queued",
     TRAIN_RESUME_STEP: "step the supervisor resumed from",
     CLUSTER_RESUME_EPOCH: "epoch found in this process's prior heartbeat",
+    DEVICE_MEM_BYTES_IN_USE: "bytes in use summed over local devices "
+                             "(absent where memory_stats() is)",
+    DEVICE_MEM_PEAK_BYTES: "peak bytes in use summed over local devices",
+    HOST_RSS_BYTES: "host process resident set size (bytes)",
+    "device{ordinal}.mem.bytes_in_use": "per-device bytes in use "
+                                        "(memory_stats)",
+    "device{ordinal}.mem.peak_bytes": "per-device peak bytes in use "
+                                      "(memory_stats)",
 }
 
 # ------------------------------------------------------------- histograms
@@ -144,8 +169,10 @@ SERVING_REQUEST_E2E = "serving.request.e2e"
 CHECKPOINT_SUBMIT = "checkpoint.submit"
 CHECKPOINT_SNAPSHOT = "checkpoint.snapshot"
 CHECKPOINT_WRITE = "checkpoint.write"
+PLAN_COMPILE = "plan.compile"
 
 HISTOGRAMS = {
+    PLAN_COMPILE: "plan build / AOT jit compile duration (ms)",
     SERVING_REQUEST_QUEUE: "ingress enqueue -> worker drain, per request "
                            "(ms)",
     SERVING_REQUEST_TRANSFORM: "transform duration per batch (ms)",
@@ -179,6 +206,7 @@ TIMINGS = {
 SERVING_REQUEST_SPAN = "serving.request"
 SERVING_PARTITION_TRANSFORM_SPAN = "serving.partition.transform"
 SERVING_PLAN_RUN_SPAN = "serving.plan.run"
+PLAN_COMPILE_SPAN = "plan.compile"
 TRAIN_STEP_SPAN = "train.step"
 CHECKPOINT_WRITE_SPAN = "checkpoint.write"
 DATA_PREFETCH_SPAN = "data.prefetch"
@@ -189,6 +217,9 @@ LM_RUN_STREAM_SPAN = "lm.run_stream"
 DEVICE_PROFILE_SPAN = "device.profile"
 
 SPANS = {
+    PLAN_COMPILE_SPAN: "one plan build / AOT compile (fingerprint, "
+                       "bucket attrs; same name as the histogram, like "
+                       "checkpoint.write)",
     SERVING_REQUEST_SPAN: "ingress root span per request (== request id)",
     SERVING_PARTITION_TRANSFORM_SPAN: "worker-hop child span per sampled "
                                       "request",
@@ -213,9 +244,12 @@ FAULT_INJECTED_EVENT = "fault.injected"
 TRAIN_RESUME_EVENT = "train.resume"
 TRAIN_RESTART_EVENT = "train.restart"
 TRAIN_PREEMPTED_EVENT = "train.preempted"
+TELEMETRY_BUNDLE_EVENT = "telemetry.bundle"
 
 EVENTS = {
     FAULT_INJECTED_EVENT: "one FaultInjector firing (site, index, kind)",
+    TELEMETRY_BUNDLE_EVENT: "one flight-recorder bundle written (reason, "
+                            "path)",
     TRAIN_RESUME_EVENT: "supervisor resumed from a checkpoint",
     TRAIN_RESTART_EVENT: "supervisor restarted the step loop from the "
                          "in-memory snapshot",
@@ -264,3 +298,13 @@ def breaker_trips(breaker: str) -> str:
 def stage_span(stage: str, action: str) -> str:
     """stage.{stage}.{action} — Timer span label."""
     return f"stage.{stage}.{action}"
+
+
+def device_mem_in_use(ordinal: int) -> str:
+    """device{ordinal}.mem.bytes_in_use — per-device in-use gauge."""
+    return f"device{ordinal}.mem.bytes_in_use"
+
+
+def device_mem_peak(ordinal: int) -> str:
+    """device{ordinal}.mem.peak_bytes — per-device peak gauge."""
+    return f"device{ordinal}.mem.peak_bytes"

@@ -44,7 +44,7 @@ class TelemetryPoller:
     def __init__(self, registry_address: str, name: Optional[str] = None,
                  interval_s: float = 10.0, window_s: Optional[float] = 60.0,
                  history: int = 720, timeout: float = 5.0,
-                 slo: bool = True):
+                 slo: bool = True, flight_on_burn: bool = False):
         if interval_s <= 0.0:
             raise ValueError("interval_s must be > 0")
         self.registry_address = registry_address
@@ -53,6 +53,11 @@ class TelemetryPoller:
         self.window_s = window_s
         self.timeout = float(timeout)
         self.slo = bool(slo)
+        # fleet-side flight trigger: when the MERGED verdict transitions
+        # to burning, dump a local debug bundle (telemetry/perf.py) — the
+        # poller is the one process that sees the fleet burn even when no
+        # single worker does
+        self.flight_on_burn = bool(flight_on_burn)
         self._samples: deque = deque(maxlen=max(int(history), 1))
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -101,6 +106,16 @@ class TelemetryPoller:
         with self._lock:
             self._samples.append(sample)
         reliability_metrics.inc(tnames.TELEMETRY_POLL_SAMPLES)
+        if self.flight_on_burn and snap.slo is not None:
+            try:
+                from .perf import get_flight_recorder
+                # the recorder owns the transition latch (source="fleet"
+                # keeps it independent of the local engine's burns) and
+                # never raises
+                get_flight_recorder().on_verdict(
+                    snap.slo, reason="fleet-slo-burn", source="fleet")
+            except Exception:  # noqa: BLE001 - the series continues
+                pass
         return sample
 
     # -- read side -----------------------------------------------------------

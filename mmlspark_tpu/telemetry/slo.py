@@ -127,11 +127,17 @@ class SLOEngine:
         return {"window_s": window_s, "errors": err_n,
                 "total": total.window.total(window_s)}
 
-    def verdict(self) -> dict:
+    def verdict(self, notify: bool = True) -> dict:
         """The per-worker SLO verdict: every objective with per-window
         counts (mergeable), rates, burn rates, and the ok/burning flags.
         `ok` is the short window within budget; `burning` is EVERY
-        window over budget (sustained burn)."""
+        window over budget (sustained burn).
+
+        Every evaluation notifies the flight recorder (telemetry/perf.py)
+        so an ok->burning TRANSITION dumps a debug bundle at the moment
+        of distress; `notify=False` is for readers that must not
+        re-trigger it (the recorder itself, capturing the verdict for
+        the bundle it is writing)."""
         out = []
         for obj in self.objectives:
             windows = []
@@ -145,10 +151,20 @@ class SLOEngine:
             out.append({"objective": obj._asdict(), "windows": windows,
                         "ok": windows[0]["burn_rate"] <= 1.0,
                         "burning": burning})
-        return {"objectives": out,
-                "ok": all(o["ok"] for o in out),
-                "burning": any(o["burning"] for o in out),
-                "workers": 1}
+        result = {"objectives": out,
+                  "ok": all(o["ok"] for o in out),
+                  "burning": any(o["burning"] for o in out),
+                  "workers": 1}
+        if notify:
+            # lazy + guarded: the verdict must render even if the
+            # recorder (or its disk) is broken, and a disabled recorder
+            # costs one attribute read
+            try:
+                from .perf import get_flight_recorder
+                get_flight_recorder().on_verdict(result)
+            except Exception:  # noqa: BLE001
+                pass
+        return result
 
 
 def _finish_window(obj: dict, m: dict) -> dict:

@@ -501,6 +501,17 @@ class Tracer:
             out = [s for s in out if s["name"] == name]
         return out
 
+    def pending_tail(self) -> list:
+        """Snapshot of the tail stage's TENTATIVE traces — the span trees
+        still waiting on their root's verdict. The flight recorder dumps
+        these next to the ring: at the moment of distress, the request
+        most worth seeing is often the one still in flight.
+        `[{"trace_id", "root", "spans": [...]}]`, insertion order."""
+        with self._lock:
+            return [{"trace_id": tid, "root": e["root"],
+                     "spans": list(e["spans"])}
+                    for tid, e in self._pending.items()]
+
     def export_jsonl(self, path: str, clear: bool = False) -> int:
         """Write the ring to a JSONL file (one span per line, seq order);
         returns the number of spans written."""
