@@ -114,8 +114,16 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
     t0 = time.time()
     fit_booster(x, y, params, prebinned=staged)
     warmup_s = time.time() - t0
+    # goodput/MFU accounting on the TIMED fit (telemetry/goodput.py):
+    # the fused loop drives the clock per chunk and books the packed
+    # fetch as the device phase. MFU degrades to None here — the fused
+    # scan compiles through bare jit (no cost analysis recorded), and a
+    # guessed flops denominator would be worse than an honest absence.
+    from mmlspark_tpu.telemetry.goodput import StepClock
+    clock = StepClock()
     t0 = time.time()
-    booster, base, _ = fit_booster(x, y, params, prebinned=staged)
+    booster, base, _ = fit_booster(x, y, params, prebinned=staged,
+                                   step_clock=clock)
     elapsed = time.time() - t0
 
     from mmlspark_tpu.telemetry import perf as tperf
@@ -138,6 +146,15 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
     cstats = tperf.compile_stats()
     cstats["seconds"] = round(cstats["seconds"], 3)
     out["compile"] = cstats
+    gsnap = clock.snapshot()
+    out["goodput"] = round(gsnap["goodput"], 4)
+    out["mfu"] = gsnap["mfu"]   # None: documented degrade (see above)
+    if gsnap["mfu"] is None:
+        out["mfu_note"] = ("no cost analysis for the bare-jit fused scan; "
+                           "set flops_per_step/MMLSPARK_TPU_PEAK_TFLOPS "
+                           "or compile via telemetry.perf to enable")
+    out["step_phases"] = {k: round(v, 4)
+                          for k, v in gsnap["phases"].items()}
     if copy_gbps > 0:
         out["measured_copy_gbps"] = round(copy_gbps, 1)
         out["hbm_utilization"] = round(
@@ -416,21 +433,51 @@ def _bench_serving():
             sect[f"{tag}_plan_misses"] = snap.get("serving.plan.misses", 0)
         return res.req_per_sec, sect
 
-    out = {}
-    legacy_rps, sect = closed_loop("legacy", "microbatch", fast_path=False)
-    out.update(sect)
-    # linger 0 = adaptive drain-available coalescing: under closed-loop
-    # load arrivals accumulate while the worker scores, so batches form
-    # without spending latency budget — on this 1-core host a positive
-    # linger only adds tail latency (it buys occupancy for device-bound
-    # stages; see docs/serving.md "Latency tuning")
-    fast_rps, sect = closed_loop("coalesced", "microbatch", fast_path=True,
-                                 linger_ms=0.0)
-    out.update(sect)
+    def ab_round():
+        """One back-to-back legacy/coalesced pair. Pairing keeps both
+        sides of a ratio under the SAME host load; a drifting contended
+        host then moves the pair together, not the ratio."""
+        legacy_rps, legacy_sect = closed_loop("legacy", "microbatch",
+                                              fast_path=False)
+        # linger 0 = adaptive drain-available coalescing: under
+        # closed-loop load arrivals accumulate while the worker scores,
+        # so batches form without spending latency budget — on this
+        # 1-core host a positive linger only adds tail latency (it buys
+        # occupancy for device-bound stages; see docs/serving.md)
+        fast_rps, fast_sect = closed_loop("coalesced", "microbatch",
+                                          fast_path=True, linger_ms=0.0)
+        return (fast_rps / max(legacy_rps, 1e-9),
+                legacy_rps, fast_rps, {**legacy_sect, **fast_sect})
+
+    def ab_set():
+        return sorted((ab_round() for _ in range(3)), key=lambda r: r[0])
+
+    def spread_of(runs):
+        speeds = [r[0] for r in runs]
+        return (speeds[-1] - speeds[0]) / max(speeds[1], 1e-9)
+
+    # deflake: MEDIAN of 3 paired A/B rounds. A contended host shows up
+    # as a wide spread across rounds (the 2.1-2.5x wobble this section
+    # used to report as a single draw); one quiet-host retry after a
+    # settle pause keeps whichever set is tighter. The spread rides the
+    # output either way, so the artifact says how noisy the host was.
+    runs = ab_set()
+    retried = False
+    if spread_of(runs) > 0.35:
+        retried = True
+        time.sleep(2.0)          # let transient load pass
+        again = ab_set()
+        if spread_of(again) < spread_of(runs):
+            runs = again
+    speedup, legacy_rps, fast_rps, sect = runs[1]   # the median pair
+    out = dict(sect)
+    out["speedup_runs"] = [round(r[0], 3) for r in runs]
+    out["speedup_spread"] = round(spread_of(runs), 3)
+    out["speedup_retried"] = retried
     cont_rps, sect = closed_loop("continuous", "continuous", fast_path=True,
                                  n_clients=4, per_client=250)
     out.update(sect)
-    out["speedup_vs_legacy"] = round(fast_rps / max(legacy_rps, 1e-9), 2)
+    out["speedup_vs_legacy"] = round(speedup, 2)
 
     # -- serial single-request latency, continuous mode ---------------------
     import urllib.request
@@ -912,17 +959,25 @@ def _bench_lm_long_context():
                              t._batch_sharding)
     t.params, t.opt_state, loss = t._step(t.params, t.opt_state, tok_dev)
     float(loss)                            # drain the queue before timing
-    reps = 5
-    t0 = time.time()
-    for _ in range(reps):
-        t.params, t.opt_state, loss = t._step(t.params, t.opt_state,
-                                              tok_dev)
-    l2 = float(loss)
-    dt = (time.time() - t0) / reps
     mm_params = L * (4 * D * D + 2 * D * FF)
     flops_fwd = 2 * S * mm_params + 2 * S * D * V + L * 2 * S * S * D
     flops_step = 3 * flops_fwd
+    # the StepClock rides the timed loop: per-rep host dispatch, device
+    # time surfacing at the end-of-chain fetch, goodput/MFU from the same
+    # analytic flops the headline MFU uses (telemetry/goodput.py)
+    from mmlspark_tpu.telemetry.goodput import StepClock
+    clock = StepClock(flops_per_step=flops_step,
+                      peak_flops=V5E_BF16_PEAK_TFLOPS * 1e12)
+    reps = 5
+    t0 = time.time()
+    for k in range(reps):
+        with clock.step(k):
+            t.params, t.opt_state, loss = t._step(t.params, t.opt_state,
+                                                  tok_dev)
+    l2 = clock.device_block(lambda: float(loss))
+    dt = (time.time() - t0) / reps
     mfu = flops_step / dt / (V5E_BF16_PEAK_TFLOPS * 1e12)
+    gsnap = clock.snapshot()
     print(json.dumps({
         "metric": "lm_train_step_16k_tokens_s", "value": round(dt, 3),
         "unit": "s/step", "vs_baseline": round(mfu, 4),
@@ -930,6 +985,11 @@ def _bench_lm_long_context():
         "model_params": n_params,
         "model_flops_per_step": flops_step,
         "mfu_vs_bf16_peak": round(mfu, 4),
+        "goodput": round(gsnap["goodput"], 4),
+        "mfu": (round(gsnap["mfu"], 4)
+                if gsnap["mfu"] is not None else None),
+        "step_phases": {k: round(v, 4)
+                        for k, v in gsnap["phases"].items()},
         "loss_step1": round(float(l1), 3), "loss_last": round(float(l2), 3),
         "mesh": mesh_kind,
         "remat": remat,

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, Optional
 
 from ..reliability.metrics import reliability_metrics
@@ -42,7 +43,8 @@ class DevicePrefetcher:
     prefetch arbitrary per-item work (e.g. a sharded `_to_device`)."""
 
     def __init__(self, source: Iterable, depth: int = 2,
-                 put: Optional[Callable] = None, metrics=None):
+                 put: Optional[Callable] = None, metrics=None,
+                 step_clock=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         if put is None:
@@ -52,6 +54,10 @@ class DevicePrefetcher:
         self._source = source
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._metrics = metrics if metrics is not None else reliability_metrics
+        # goodput accounting (telemetry/goodput.py): mid-stream time the
+        # CONSUMER spends blocked on an empty queue is the training
+        # loop's data-wait phase — noted on the clock when one is wired
+        self._clock = step_clock
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._feed, daemon=True,
                                         name="ingest-prefetch")
@@ -108,8 +114,11 @@ class DevicePrefetcher:
         # the _DONE sentinel are inherent, not overlap failures, so
         # neither may count against the pipeline
         was_empty = self._consumed > 0 and self._q.empty()
+        t_wait = (time.perf_counter()
+                  if was_empty and self._clock is not None else None)
         item = self._q.get()
         if item is _DONE:
+            # end-of-stream wait: inherent, not a data-wait (see above)
             self._thread.join(timeout=5)
             self._finish_span()
             raise StopIteration
@@ -118,6 +127,11 @@ class DevicePrefetcher:
             self._finish_span(error=type(item).__name__)
             raise item
         if was_empty:
+            if t_wait is not None:
+                # same exclusions as the stall counter: only a REAL
+                # batch that kept the consumer waiting books data_wait
+                self._clock.note("data_wait",
+                                 time.perf_counter() - t_wait)
             self._stalls += 1
             self._metrics.inc(tnames.DATA_PREFETCH_STALLS)
         self._consumed += 1

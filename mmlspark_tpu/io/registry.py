@@ -32,12 +32,20 @@ from .serving import _ThreadingServer
 
 
 class ServiceInfo(NamedTuple):
-    """One registered server (reference: ServiceInfo, HTTPSourceV2.scala:460)."""
+    """One registered server (reference: ServiceInfo, HTTPSourceV2.scala:460).
+
+    `kind` says what the endpoint IS — ``"serving"`` (a ServingServer
+    answering inference traffic) or ``"trainer"`` (a training process's
+    metrics/slo exposition surface, `telemetry.exposition.expose_trainer`)
+    — so `scrape_cluster`/`TelemetryPoller` can target one class without
+    probing. Wire compat: a ``"serving"`` register omits the field (the
+    pre-kind body byte-for-byte) and a missing field parses as serving."""
     name: str
     host: str
     port: int
     process_id: int
     num_partitions: int
+    kind: str = "serving"
 
     @property
     def address(self) -> str:
@@ -158,7 +166,8 @@ def report_server_to_registry(registry_address: str, name: str, host: str,
                               port: int, process_id: int = 0,
                               num_partitions: int = 1,
                               timeout: float = 10.0,
-                              retry_policy: Optional[RetryPolicy] = None) -> None:
+                              retry_policy: Optional[RetryPolicy] = None,
+                              kind: str = "serving") -> None:
     """Worker-side report (WorkerClient.reportServerToDriver,
     HTTPSourceV2.scala:460-468).
 
@@ -172,8 +181,14 @@ def report_server_to_registry(registry_address: str, name: str, host: str,
         jitter=0.25, deadline=timeout,
         metric_name=tnames.REGISTRY_REPORT_RETRIES)
     info = ServiceInfo(name=name, host=host, port=port,
-                       process_id=process_id, num_partitions=num_partitions)
-    data = json.dumps(info._asdict()).encode()
+                       process_id=process_id,
+                       num_partitions=num_partitions, kind=kind)
+    body = info._asdict()
+    if body["kind"] == "serving":
+        # wire compat (the satellite contract): the default kind posts
+        # the pre-kind body byte-for-byte; only trainers say so
+        body.pop("kind")
+    data = json.dumps(body).encode()
     last_err: Optional[Exception] = None
     headers = get_tracer().inject({"Content-Type": "application/json"})
     for att in policy.attempts():

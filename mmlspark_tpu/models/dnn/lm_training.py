@@ -222,7 +222,7 @@ class ShardedLMTrainer:
     def run_stream(self, batches, steps_per_batch: int = 1,
                    prefetch: int = 2, checkpoint_dir: str = None,
                    checkpoint_every: int = 10, resume: bool = True,
-                   **supervisor_kw) -> list:
+                   step_clock=None, **supervisor_kw) -> list:
         """Train over an iterable of host (B, S) token batches with the
         bounded ingest prefetcher (data.DevicePrefetcher): batch k+1 rides
         host->device transfer (and any upstream tokenize/load work the
@@ -245,7 +245,12 @@ class ShardedLMTrainer:
         payload). `batches` must then be a finite re-indexable sequence —
         the resumed/rewound run replays from the cursor. Extra kwargs
         (step_timeout, retry_policy, heartbeat, faults, ...) pass through
-        to TrainingSupervisor."""
+        to TrainingSupervisor.
+
+        `step_clock` (telemetry.goodput.StepClock; created by default
+        when supervised) rides the whole path: the prefetcher notes its
+        data-wait on it, the loss fetch books as device-compute, and the
+        supervisor decomposes every step into the goodput/MFU account."""
         import operator
         import time as _time
 
@@ -257,6 +262,14 @@ class ShardedLMTrainer:
             raise ValueError(
                 f"steps_per_batch must be >= 1, got {steps_per_batch}")
         _run_t0 = _time.perf_counter()
+        clock = step_clock
+
+        def fetch(loss):
+            # float(loss) is THE block-until-ready boundary of a step:
+            # the async dispatch's device time surfaces here
+            if clock is not None:
+                return clock.device_block(lambda: float(loss))
+            return float(loss)
 
         def one_batch(tok_dev):
             if steps_per_batch == 1:
@@ -270,7 +283,7 @@ class ShardedLMTrainer:
                 self.params, self.opt_state, loss = self._multi(
                     self.params, self.opt_state, tok_dev,
                     jnp.asarray(steps_per_batch, jnp.int32))
-            return float(loss)
+            return fetch(loss)
 
         if checkpoint_dir is None:
             if supervisor_kw:
@@ -279,7 +292,8 @@ class ShardedLMTrainer:
                     f"checkpoint_dir")
             losses = []
             with DevicePrefetcher(batches, depth=prefetch,
-                                  put=self._to_device) as pf:
+                                  put=self._to_device,
+                                  step_clock=clock) as pf:
                 for tok_dev in pf:
                     losses.append(one_batch(tok_dev))
             get_tracer().record(
@@ -289,7 +303,10 @@ class ShardedLMTrainer:
             return losses
 
         from ...reliability.supervisor import TrainingSupervisor
+        from ...telemetry.goodput import StepClock
         import jax
+        if clock is None:
+            clock = StepClock()
         if jax.process_count() > 1:
             # every process would race the same step dir (save_lm_checkpoint
             # gates on the leader + barriers; the async writer has no such
@@ -313,7 +330,7 @@ class ShardedLMTrainer:
             if stream["pf"] is not None:
                 stream["pf"].close()
             pf = DevicePrefetcher(batches[step:], depth=prefetch,
-                                  put=self._to_device)
+                                  put=self._to_device, step_clock=clock)
             stream["pf"], stream["it"] = pf, iter(pf)
 
         def step_fn(step):
@@ -321,7 +338,7 @@ class ShardedLMTrainer:
 
         sup = TrainingSupervisor(checkpoint_dir, snapshot, restore,
                                  checkpoint_every=checkpoint_every,
-                                 **supervisor_kw)
+                                 step_clock=clock, **supervisor_kw)
         try:
             out = sup.run(step_fn, len(batches), seek=seek, resume=resume)
             get_tracer().record(

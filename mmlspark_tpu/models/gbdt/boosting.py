@@ -414,7 +414,7 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
                       init_base: float = 0.0, ingest=None,
                       init_margin: Optional[np.ndarray] = None,
                       init_rng_key: Optional[np.ndarray] = None,
-                      iter_offset: int = 0):
+                      iter_offset: int = 0, step_clock=None):
     """Train a Booster on host arrays. Single-device by default; the
     distributed path (distributed.py) passes a shard_map-wrapped `tree_fn`
     and a sharding `put_fn`, and this same loop runs over the mesh.
@@ -448,8 +448,34 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
     # per-iteration (host loop) / per-chunk (fused scan) children attach to
     # it. No ambient context (unsampled fit) -> every mark is one compare.
     _tel = get_tracer()
+    # goodput accounting (telemetry/goodput.py): opt-in per fit — bench
+    # and supervised fits pass a StepClock; a bare fit pays nothing.
+    _clk = step_clock
+    import contextlib
 
-    def _iter_mark(it_idx, t0):
+    def _clk_step(idx):
+        return _clk.step(idx) if _clk is not None else \
+            contextlib.nullcontext()
+
+    def _clk_ckpt(fn, *a, **kw):
+        if _clk is None:
+            return fn(*a, **kw)
+        t0 = time.perf_counter()
+        try:
+            return fn(*a, **kw)
+        finally:
+            _clk.note("checkpoint", time.perf_counter() - t0)
+            _clk.marked()
+
+    def _iter_mark(it_idx, t0, ck_s: float = 0.0):
+        if _clk is not None:
+            # host-loop iterations feed the clock via externally-measured
+            # walls (the body has break paths a context manager can't
+            # straddle); the periodic checkpoint's stall rides as a note
+            _clk.add_step(time.perf_counter() - t0,
+                          {"checkpoint": ck_s} if ck_s > 0.0 else None)
+            if ck_s > 0.0:
+                _clk.marked()
         if _tel.current() is not None:
             _tel.record(tnames.GBDT_ITERATION_SPAN,
                         duration_ms=(time.perf_counter() - t0) * 1000.0,
@@ -669,26 +695,31 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
             _chunk_t0 = time.perf_counter()
             clen = min(chunk, p.num_iterations - it)
             key, kc = jax.random.split(key)
-            (margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c,
-             mts) = fused(
-                d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_, vy_j,
-                v_margin_, kc, it + iter_offset, p, cfg, clen, k_out,
-                has_valid=has_valid)
-            parts.append((sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c))
-            if checkpoint_fn is not None:
-                # chunk boundary = natural checkpoint step: build the
-                # booster-so-far from the accumulated parts (host-cheap).
-                # The live margin + PRNG key ride along so a resumed fit
-                # continues on bit-identical state (the snapshot D2H is the
-                # cheap host copy; the disk write may be async downstream)
-                _sf, _sb, _lv, _gn, _cv, _ic, _cw = _fetch_packed(parts)
-                _tc = np.tile(np.arange(k_out, dtype=np.int32),
-                              _sf.shape[0] // max(k_out, 1))
-                checkpoint_fn(it + clen, _build_booster(
-                    _sf, _sb, _lv, _tc, mapper, p, k_out, n_features, -1,
-                    init_booster, base, gain=_gn, cover=_cv, is_cat=_ic,
-                    cat_words=_cw), base,
-                    final=False, margin=margin, rng_key=key)
+            with _clk_step(it):
+                (margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, ic_c,
+                 cw_c, mts) = fused(
+                    d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_,
+                    vy_j, v_margin_, kc, it + iter_offset, p, cfg, clen,
+                    k_out, has_valid=has_valid)
+                parts.append((sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c))
+                if checkpoint_fn is not None:
+                    # chunk boundary = natural checkpoint step: build the
+                    # booster-so-far from the accumulated parts (host-
+                    # cheap). The live margin + PRNG key ride along so a
+                    # resumed fit continues on bit-identical state (the
+                    # snapshot D2H is the cheap host copy; the disk write
+                    # may be async downstream)
+                    def _chunk_ckpt():
+                        _sf, _sb, _lv, _gn, _cv, _ic, _cw = \
+                            _fetch_packed(parts)
+                        _tc = np.tile(np.arange(k_out, dtype=np.int32),
+                                      _sf.shape[0] // max(k_out, 1))
+                        checkpoint_fn(it + clen, _build_booster(
+                            _sf, _sb, _lv, _tc, mapper, p, k_out,
+                            n_features, -1, init_booster, base, gain=_gn,
+                            cover=_cv, is_cat=_ic, cat_words=_cw), base,
+                            final=False, margin=margin, rng_key=key)
+                    _clk_ckpt(_chunk_ckpt)
             if track:
                 for i, mv in enumerate(np.asarray(mts)):
                     mv = float(mv)
@@ -718,7 +749,13 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
         # full transfer round-trip (5 serial fetches measured ~0.5s over a
         # tunneled link), so pack the five (T, max_nodes) arrays into a
         # single f32 device array (bitcasting the i32 ones) and fetch once.
-        sf, sb, lv, gn, cv, ic, cw = _fetch_packed(parts)
+        # This fetch is the loop's block-until-ready boundary — where the
+        # async dispatch's device time surfaces for the goodput account.
+        if _clk is not None:
+            sf, sb, lv, gn, cv, ic, cw = _clk.device_block(
+                lambda: _fetch_packed(parts))
+        else:
+            sf, sb, lv, gn, cv, ic, cw = _fetch_packed(parts)
         if stop_at is not None:  # drop trees grown past the stopping point
             keep = stop_at * k_out
             sf, sb, lv = sf[:keep], sb[:keep], lv[:keep]
@@ -888,7 +925,9 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
                 break
         if cb.after_iteration:
             cb.after_iteration(it, metric_val if metric_val is not None else float("nan"))
+        _ck_s = 0.0
         if checkpoint_fn is not None and (it + 1) % max(int(checkpoint_interval), 1) == 0:
+            _ck_t0 = time.perf_counter()
             _max_nodes = 2 ** (p.max_depth + 1) - 1
             _sf = np.stack([tr.split_feature for tr in trees])
             _sb = np.stack([tr.split_bin for tr in trees])
@@ -905,7 +944,8 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
                 k_out, n_features, -1, init_booster, base, gain=_gn,
                 cover=_cv, is_cat=_ic, cat_words=_cw), base, final=False,
                 margin=margin, rng_key=key)
-        _iter_mark(it, _it_t0)
+            _ck_s = time.perf_counter() - _ck_t0
+        _iter_mark(it, _it_t0, ck_s=_ck_s)
 
     max_nodes = 2 ** (p.max_depth + 1) - 1
     T = len(trees)

@@ -20,6 +20,7 @@ Spark (LightGBMParams.scala:58).
 from __future__ import annotations
 
 import functools
+import hashlib
 from typing import Optional
 
 import jax
@@ -33,10 +34,30 @@ from . import trainer
 from .boosting import fit_booster
 
 
+def _stable_tag(*parts) -> str:
+    """Process- and run-stable fingerprint suffix for a compile-log key
+    (builtin hash() is PYTHONHASHSEED-salted — two hosts of one fleet
+    would record the same executable under different rows, and the
+    autotuner's per_key training rows could never be joined across
+    runs)."""
+    return hashlib.sha1(repr(parts).encode()).hexdigest()[:10]
+
+
+def _mesh_tag(mesh) -> tuple:
+    return tuple(sorted((str(k), int(v)) for k, v in mesh.shape.items()))
+
+
 @functools.lru_cache(maxsize=128)
 def _compiled_tree_fn(mesh, cfg, voting: Optional[int]):
-    """Build + jit the shard_map'd tree grower once per (mesh, config).
-    Rebuilding it per call would re-trace and recompile every tree."""
+    """Build the shard_map'd tree grower once per (mesh, config),
+    AOT-compiled through the telemetry compile log (telemetry.perf
+    AotCache): the executable actually used for every distributed tree
+    carries its cost analysis AND collective ops/bytes (the psum
+    histogram all-reduce) as a compile record — the COMM_TRAFFIC account
+    riding every fit, not just the bench harness. Rebuilding per call
+    would re-trace and recompile every tree."""
+    from ...telemetry.perf import AotCache
+
     def fn(bins, grad, hess, fmask, count_w):
         return trainer.train_one_tree(bins, grad, hess, fmask, cfg=cfg,
                                       axis_name=DATA_AXIS, voting_top_k=voting,
@@ -48,7 +69,12 @@ def _compiled_tree_fn(mesh, cfg, voting: Optional[int]):
         out_specs=(trainer.Tree(P(), P(), P(), P(), P(), P(), P()),
                    P(DATA_AXIS)),
         check_rep=False)
-    return jax.jit(mapped)
+    mode = "voting_parallel" if voting is not None else "data_parallel"
+    # fingerprint carries the builder key: a DIFFERENT cfg compiling at
+    # the same shapes is a new executable, not a recompile of this one
+    return AotCache(mapped, label=f"gbdt.tree.{mode}",
+                    fingerprint=f"gbdt.tree.{mode}#"
+                                f"{_stable_tag(_mesh_tag(mesh), cfg, voting)}")
 
 
 def make_sharded_tree_fn(mesh, parallelism: str = "data_parallel",
@@ -83,7 +109,14 @@ def _compiled_chunk_fn(mesh, p, cfg, chunk_len: int, k_out: int,
                   P()),
         out_specs=(margin_spec, P(), P(), P(), P(), P(), P(), P(), P(), P()),
         check_rep=False)
-    return jax.jit(mapped)
+    # same AOT-through-the-compile-log treatment as the tree grower (see
+    # _compiled_tree_fn): the fused chunk's collectives become records
+    from ...telemetry.perf import AotCache
+    mode = "voting_parallel" if voting is not None else "data_parallel"
+    tag = _stable_tag(_mesh_tag(mesh), p, cfg, chunk_len, k_out,
+                      has_valid, multiclass, voting)
+    return AotCache(mapped, label=f"gbdt.chunk.{mode}",
+                    fingerprint=f"gbdt.chunk.{mode}#{tag}")
 
 
 def fit_booster_distributed(x, y, params, weights=None, init_scores=None,

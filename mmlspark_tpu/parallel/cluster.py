@@ -216,20 +216,29 @@ class Heartbeat:
         """Did this process find its own prior heartbeat at startup?"""
         return self.resume_epoch is not None
 
-    def beat(self, epoch: int) -> None:
+    def beat(self, epoch: int, stats: Optional[dict] = None) -> None:
         """Atomically record the last completed epoch (tmp + os.replace —
-        a kill mid-beat leaves the previous beat, never a torn file)."""
+        a kill mid-beat leaves the previous beat, never a torn file).
+
+        `stats` is a small JSON-able dict published to peers alongside
+        the epoch — the supervisor rides its StepClock's
+        ``{"step_p50_ms", "steps", "goodput"}`` here, which is how the
+        straggler detector (telemetry.goodput.StragglerDetector) sees
+        every host's windowed step p50 without any new transport."""
         if self._faults is not None:
             self._faults.perturb("cluster.heartbeat")
         tmp = f"{self.path}.{os.getpid()}.tmp"
+        row = {"process_id": self.process_id, "epoch": int(epoch),
+               # wall_now(): beats from THIS process advance monotonically,
+               # so a same-process rejoin (the primary reader) never sees
+               # its own prior beat jump forward/backward across an NTP
+               # step. Cross-process comparisons stay approximate — each
+               # process anchors its own wall clock at start
+               "time": wall_now()}
+        if stats:
+            row["stats"] = dict(stats)
         with open(tmp, "w") as f:
-            # wall_now(): beats from THIS process advance monotonically, so
-            # a same-process rejoin (the primary reader) never sees its own
-            # prior beat jump forward/backward across an NTP step. Cross-
-            # process comparisons stay approximate — each process anchors
-            # its own wall clock at start, like any wall timestamp
-            json.dump({"process_id": self.process_id, "epoch": int(epoch),
-                       "time": wall_now()}, f)
+            json.dump(row, f)
         os.replace(tmp, self.path)
 
     def read(self, process_id: Optional[int] = None) -> Optional[dict]:
@@ -242,6 +251,26 @@ class Heartbeat:
                 return json.load(f)
         except (OSError, ValueError):
             return None
+
+    def read_all(self) -> list:
+        """Every process's last heartbeat in this directory, ordered by
+        filename (deterministic); unreadable/torn files are skipped. The
+        straggler detector's fleet view."""
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return []
+        rows = []
+        for fname in names:
+            if not (fname.startswith("heartbeat_")
+                    and fname.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, fname)) as f:
+                    rows.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+        return rows
 
     def clear(self) -> None:
         """Remove the heartbeat — call after a CLEAN finish so the next

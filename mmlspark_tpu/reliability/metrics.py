@@ -361,6 +361,13 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.get(name, default)
 
+    def peek_gauge(self, name: str) -> Optional[float]:
+        """Non-creating, absence-preserving lookup: None means the gauge
+        was never set (a goodput SLO on a process that never trained must
+        read as no-data, not as goodput 0.0)."""
+        with self._lock:
+            return self._gauges.get(name)
+
     # -- read side -----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:

@@ -61,7 +61,8 @@ logger = logging.getLogger(__name__)
 STEP_KEY = "sup_step"
 RESULTS_KEY = "sup_results"
 PREEMPTED_KEY = "sup_preempted"
-_RESERVED = (STEP_KEY, RESULTS_KEY, PREEMPTED_KEY)
+CLOCK_KEY = "sup_clock"          # StepClock accounting (goodput survives kill)
+_RESERVED = (STEP_KEY, RESULTS_KEY, PREEMPTED_KEY, CLOCK_KEY)
 
 
 class StepTimeout(RuntimeError):
@@ -247,7 +248,9 @@ class TrainingSupervisor:
                  handle_signals: bool = True,
                  heartbeat=None,
                  manager: Optional[CheckpointManager] = None,
-                 metrics=None, faults: Optional[FaultInjector] = None):
+                 metrics=None, faults: Optional[FaultInjector] = None,
+                 step_clock=None, straggler=None,
+                 straggler_threshold: float = 1.5):
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self.checkpoint_every = max(int(checkpoint_every), 0)  # 0 = final only
@@ -265,6 +268,20 @@ class TrainingSupervisor:
         self.writer = AsyncCheckpointWriter(self.manager, depth=queue_depth,
                                             metrics=self.metrics,
                                             faults=self.faults)
+        # goodput/MFU accounting (telemetry/goodput.py): the clock rides
+        # every step; its state rides the checkpoint payload so a
+        # killed-and-resumed run keeps cumulative goodput. Lazy import —
+        # this module is imported by the reliability package init.
+        from ..telemetry.goodput import StepClock, StragglerDetector
+        self.clock = (step_clock if step_clock is not None
+                      else StepClock(registry=self.metrics))
+        if straggler is None and heartbeat is not None:
+            # multi-host runs exchange per-host step p50s through the
+            # heartbeat files; every host runs the same check on its beat
+            straggler = StragglerDetector(heartbeat,
+                                          threshold=straggler_threshold,
+                                          registry=self.metrics)
+        self.straggler = straggler or None
         self.resumed_step: Optional[int] = None
         self._resumed_results: list = []
         self._last: Optional[tuple] = None   # (step, payload, results) rewind
@@ -289,6 +306,11 @@ class TrainingSupervisor:
         # makes it differ from latest_step(); seeking the data cursor past
         # state that never trained would silently skip batches)
         step = int(payload.get(STEP_KEY, loaded))
+        clock_state = payload.get(CLOCK_KEY)
+        if clock_state is not None:
+            # cumulative goodput spans the kill: the resumed run keeps
+            # the prior run's wall/lost accounting instead of reset-to-1
+            self.clock.restore_state(clock_state)
         hist = payload.get(RESULTS_KEY, ())
         import numpy as np
         if isinstance(hist, np.ndarray):   # numeric history rode the npz
@@ -333,10 +355,22 @@ class TrainingSupervisor:
                 try:
                     # step span: covers the fault site too, so an injected
                     # step failure records error=<type> on ITS step before
-                    # the restart machinery engages
-                    with get_tracer().span(tnames.TRAIN_STEP_SPAN, step=step):
+                    # the restart machinery engages. The clock wraps both:
+                    # a failed attempt's wall books as lost.
+                    with self.clock.step(step), \
+                            get_tracer().span(tnames.TRAIN_STEP_SPAN,
+                                              step=step):
                         if self.faults is not None:
-                            self.faults.perturb(f"train.step{step}")
+                            t_fault = time.perf_counter()
+                            fault = self.faults.perturb(f"train.step{step}")
+                            if fault is not None and fault.kind == "delay":
+                                # an injected stall models an external
+                                # pause (preemption, contention): wall
+                                # that produced no state — lost time in
+                                # the goodput account
+                                self.clock.note(
+                                    "lost",
+                                    time.perf_counter() - t_fault)
                         out = self._call_step(step_fn, step)
                 except self.restart_on as e:
                     step, results = self._restart(e, seek)
@@ -408,6 +442,8 @@ class TrainingSupervisor:
             raise err
         assert self._last is not None
         last_step, payload, results = self._last
+        # everything since that snapshot re-executes: its wall is lost
+        self.clock.rewound()
         self.metrics.inc(tnames.TRAIN_STEP_RESTARTS)
         get_tracer().event(tnames.TRAIN_RESTART_EVENT, step=last_step,
                            error=type(err).__name__)
@@ -429,6 +465,8 @@ class TrainingSupervisor:
         for k in _RESERVED:
             payload.pop(k, None)
         payload[STEP_KEY] = int(step)
+        payload[CLOCK_KEY] = np.asarray(self.clock.state_vector(),
+                                        np.float64)
         if self._results_numeric and all(
                 isinstance(r, (int, float, np.floating, np.integer))
                 for r in results[self._results_probed:]):
@@ -462,20 +500,31 @@ class TrainingSupervisor:
             if step is None:
                 self.heartbeat.clear()
             else:
-                self.heartbeat.beat(step)
+                # the beat carries this host's windowed step p50 so
+                # every peer's straggler check sees it
+                self.heartbeat.beat(step, stats=self.clock.beat_stats())
         except Exception as e:  # noqa: BLE001 - observability must not kill
             self.metrics.inc(tnames.CLUSTER_HEARTBEAT_ERRORS)
             logger.warning("heartbeat update failed (%s: %s)",
                            type(e).__name__, e)
+        if step is not None and self.straggler is not None:
+            self.straggler.check()   # never raises (observability)
 
     def _mark(self, step: int, results: list, write: bool) -> None:
+        t0 = time.perf_counter()
         payload = self._snapshot(step, results)
         self._last = (step, payload, list(results))
         if write:
             self.writer.submit(step, payload)
+        # snapshot+submit is the checkpoint STALL the step thread pays
+        # (the disk write itself rides the async writer); a durable mark
+        # also resets the rewindable-wall window
+        self.clock.note("checkpoint", time.perf_counter() - t0)
+        self.clock.marked()
         self._beat(step)
 
     def _finalize(self, step: int, results: list, preempted: bool) -> None:
+        t0 = time.perf_counter()
         payload = self._snapshot(step, results)
         payload[PREEMPTED_KEY] = bool(preempted)
         try:
@@ -497,6 +546,10 @@ class TrainingSupervisor:
                 self.manager.save(step, payload)
             except Exception:  # noqa: BLE001
                 pass
+        # the final synchronous write (and its queue drain) is checkpoint
+        # stall too; publish so the run's last gauges include it
+        self.clock.note("checkpoint", time.perf_counter() - t0)
+        self.clock.publish()
         if preempted:
             self.metrics.inc(tnames.TRAIN_PREEMPTED)
             get_tracer().event(tnames.TRAIN_PREEMPTED_EVENT, step=step,

@@ -61,11 +61,15 @@ _LAZY_NAMES = {
     "merge_states": "exposition", "metrics_http_response": "exposition",
     "render_prometheus": "exposition", "scrape_cluster": "exposition",
     "state_snapshot": "exposition",
+    "ExpositionServer": "exposition", "expose_trainer": "exposition",
     "WindowedCounter": "window", "WindowedHistogram": "window",
     "Objective": "slo", "SLOEngine": "slo", "default_objectives": "slo",
-    "merge_verdicts": "slo",
+    "merge_verdicts": "slo", "trainer_objectives": "slo",
     "TelemetryPoller": "poller",
-    "CompileLog": "perf", "FlightRecorder": "perf",
+    "StepClock": "goodput", "StragglerDetector": "goodput",
+    "flops_from_compile_log": "goodput",
+    "CompileLog": "perf", "FlightRecorder": "perf", "AotCache": "perf",
+    "collective_traffic": "perf",
     "compile_with_analysis": "perf", "executable_analysis": "perf",
     "record_plan_compile": "perf", "get_compile_log": "perf",
     "compile_stats": "perf", "hbm_utilization": "perf",
@@ -89,11 +93,14 @@ __all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
            "TAIL_ENV",
            "render_prometheus", "metrics_http_response", "merge_states",
            "state_snapshot", "scrape_cluster", "ClusterSnapshot",
-           "PROM_CONTENT_TYPE",
+           "PROM_CONTENT_TYPE", "ExpositionServer", "expose_trainer",
            "WindowedHistogram", "WindowedCounter",
            "Objective", "SLOEngine", "default_objectives", "merge_verdicts",
+           "trainer_objectives",
            "TelemetryPoller",
-           "CompileLog", "FlightRecorder", "compile_with_analysis",
+           "StepClock", "StragglerDetector", "flops_from_compile_log",
+           "CompileLog", "FlightRecorder", "AotCache", "collective_traffic",
+           "compile_with_analysis",
            "executable_analysis", "record_plan_compile", "get_compile_log",
            "compile_stats", "hbm_utilization", "sample_resource_gauges",
            "sample_resource_stats", "get_flight_recorder",

@@ -17,6 +17,18 @@ elapsed-seconds metrics). Accepts the driver wrapper format, raw bench
 JSONL (one ``{"metric": ...}`` object per line), or a single JSON
 object; rounds order by the wrapper's ``n`` when present, else by
 filename.
+
+It also reads the ``MULTICHIP_r0N.json`` wrapper format (a driver
+object whose ``tail`` holds ``GPIPE_MSWEEP {json}`` / ``TRAFFIC
+{json}`` lines): the GPipe microbatch sweep becomes
+``gpipe_m<M>_{s_per_step,bubble_fraction}`` records and the collective
+account becomes ``comm.<program>.<kind>.{ops,bytes}`` records — all
+marked lower-is-better on the record itself (``"lower_better": true``),
+so bubble-fraction and collective-bytes trajectories gate exactly like
+BENCH_rNN metrics:
+
+    python -m mmlspark_tpu.telemetry.benchdiff --threshold 0.1 \\
+        MULTICHIP_r*.json
 """
 from __future__ import annotations
 
@@ -27,6 +39,9 @@ import sys
 from typing import List, Optional, Tuple
 
 _DIGITS = re.compile(r"(\d+)")
+# MULTICHIP tail lines: an UPPERCASE tag followed by one JSON object
+# (the dryrun prints "GPIPE_MSWEEP {...}" and "TRAFFIC {...}")
+_TAGGED = re.compile(r"^([A-Z][A-Z0-9_]*)\s+(\{.*)$")
 
 
 def _natural_key(path: str) -> tuple:
@@ -37,9 +52,57 @@ def _natural_key(path: str) -> tuple:
                  for part in _DIGITS.split(path))
 
 
+def _sweep_records(sweep: dict) -> list:
+    """GPIPE_MSWEEP -> per-M records. Both step time and bubble fraction
+    regress by GROWING, so they are born lower-is-better."""
+    records = []
+    for m in sorted(sweep, key=str):
+        entry = sweep[m]
+        if not isinstance(entry, dict):
+            continue
+        for field in ("s_per_step", "bubble_fraction"):
+            v = entry.get(field)
+            if isinstance(v, (int, float)):
+                records.append({"metric": f"gpipe_m{m}_{field}",
+                                "value": float(v), "lower_better": True})
+    return records
+
+
+def _traffic_records(table: dict) -> list:
+    """TRAFFIC -> per-(program, collective-kind) records. Growing
+    collective volume is the regression the voting/bucketing designs
+    exist to prevent, so ops and bytes are lower-is-better."""
+    records = []
+    for prog in sorted(table):
+        kinds = table[prog]
+        if not isinstance(kinds, dict):
+            continue
+        for kind in sorted(kinds):
+            ent = kinds[kind]
+            if not isinstance(ent, dict):
+                continue
+            for field in ("ops", "bytes"):
+                v = ent.get(field)
+                if isinstance(v, (int, float)):
+                    records.append(
+                        {"metric": f"comm.{prog}.{kind}.{field}",
+                         "value": float(v), "lower_better": True})
+    return records
+
+
+def _tagged_records(tag: str, obj: dict) -> list:
+    """Records synthesized from one tagged tail line (MULTICHIP rounds)."""
+    if tag == "GPIPE_MSWEEP" and isinstance(obj.get("sweep"), dict):
+        return _sweep_records(obj["sweep"])
+    if tag == "TRAFFIC":
+        return _traffic_records(obj)
+    return []
+
+
 def _records_from_text(text: str) -> list:
     """Every JSON object with a "metric" key found in `text` (whole-file
-    object, wrapper with parsed/tail, or JSONL)."""
+    object, wrapper with parsed/tail, or JSONL), plus records synthesized
+    from MULTICHIP-style tagged tail lines."""
     text = text.strip()
     if not text:
         return []
@@ -53,9 +116,20 @@ def _records_from_text(text: str) -> list:
             return [obj]
         # driver wrapper: {"n": ..., "parsed": {...}, "tail": "..."} —
         # harvest every bench line from the tail (multi-mode runs print
-        # several), with `parsed` as the authoritative headline
+        # several), with `parsed` as the authoritative headline. The
+        # MULTICHIP wrapper's tail carries TAGGED lines instead.
         for line in str(obj.get("tail", "")).splitlines():
             line = line.strip()
+            tagged = _TAGGED.match(line)
+            if tagged:
+                try:
+                    payload = json.loads(tagged.group(2))
+                except ValueError:
+                    continue
+                if isinstance(payload, dict):
+                    records.extend(_tagged_records(tagged.group(1),
+                                                   payload))
+                continue
             if line.startswith("{"):
                 try:
                     rec = json.loads(line)
@@ -105,13 +179,18 @@ def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
                 lower_better: Tuple[str, ...] = ()) -> Tuple[list, list]:
     """(report_lines, regressions) across rounds (already ordered).
     A regression compares the LAST round's value against the most recent
-    earlier round that carries the metric."""
+    earlier round that carries the metric. A record born with
+    ``"lower_better": true`` (MULTICHIP bubble/traffic synthesis) gates
+    as lower-is-better without a CLI flag."""
     order: dict = {}   # metric -> [(label, value)] — dict keeps insertion order
+    born_lower: set = set()
     for label, by_metric in rounds:
         for metric, rec in by_metric.items():
             v = rec.get(key)
             if isinstance(v, (int, float)):
                 order.setdefault(metric, []).append((label, float(v)))
+                if rec.get("lower_better"):
+                    born_lower.add(metric)
     lines: list = []
     regressions: list = []
     for metric, series in order.items():
@@ -129,12 +208,13 @@ def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
         lines.append(f"{metric} [{key}]: {traj}  last-vs-prev "
                      f"{delta:+.1%}")
         if threshold is not None:
-            drop = -delta if metric not in lower_better else delta
+            lb = metric in lower_better or metric in born_lower
+            drop = delta if lb else -delta
             if drop > threshold:
                 regressions.append(
                     f"{metric}: {prev:g} -> {last:g} "
                     f"({delta:+.1%}, threshold {threshold:.0%}"
-                    f"{', lower-better' if metric in lower_better else ''})")
+                    f"{', lower-better' if lb else ''})")
     return lines, regressions
 
 

@@ -27,8 +27,10 @@ from __future__ import annotations
 
 import json
 import re
+import threading
 import urllib.parse
 import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import NamedTuple, Optional
 
 from ..reliability.metrics import (Histogram, MetricsRegistry,
@@ -264,6 +266,127 @@ def _bundle_response() -> tuple:
     return 200, json.dumps(manifest).encode(), "application/json"
 
 
+# ------------------------------------------------- trainer scrape surface
+class _ExpositionHandler(BaseHTTPRequestHandler):
+    server_version = "mmlspark_tpu-exposition/1.0"
+
+    def _answer(self):
+        # EXPOSITION_PATHS is owned by io/serving (the serving ingress
+        # mounts the same handler body); imported lazily to keep this
+        # module importable below the io layer
+        from ..io.serving import EXPOSITION_PATHS
+        if self.path.split("?", 1)[0] not in EXPOSITION_PATHS:
+            status, ctype = 404, "application/json"
+            payload = b'{"error": "not found"}'
+        else:
+            status, payload, ctype = metrics_http_response(
+                self.path, registry=self.server.exposition_registry)
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802
+        self._answer()
+
+    def do_POST(self):  # noqa: N802 - pollers that POST still get answered
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length > 0:
+            self.rfile.read(length)
+        self._answer()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class _ExpositionHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 32
+
+
+class ExpositionServer:
+    """The trainer-side scrape surface: a lightweight HTTP server that
+    answers ONLY the exposition paths (`/metrics`, `/metrics.json`,
+    `/slo`, `/debug/bundle`) — the same handler body `ServingServer` and
+    `ServiceRegistry` mount, without a serving queue behind it. A
+    training process mounts one so `scrape_cluster`/`TelemetryPoller`
+    can pull its goodput/MFU gauges and step histograms next to the
+    serving fleet's latency (see `expose_trainer` for the registered
+    one-liner)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry=None):
+        self._httpd = _ExpositionHTTPServer((host, port),
+                                            _ExpositionHandler)
+        self._httpd.exposition_registry = registry  # type: ignore
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="trainer-exposition")
+
+    def start(self) -> "ExpositionServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+
+def expose_trainer(host: str = "127.0.0.1", port: int = 0,
+                   registry_address: Optional[str] = None,
+                   name: str = "trainer", process_id: Optional[int] = None,
+                   goodput_floor: Optional[float] = 0.9,
+                   registry=None) -> ExpositionServer:
+    """Mount the trainer scrape surface and (optionally) register it.
+
+    - Starts an `ExpositionServer` on (host, port).
+    - With `registry_address`, reports it to the `ServiceRegistry` with
+      ``kind="trainer"`` so `scrape_cluster(kind=...)` and the poller can
+      target trainers without probing.
+    - With `goodput_floor` set (default 0.9), appends the goodput-floor
+      `Objective` to the process SLO engine — `/slo` on this endpoint
+      then burns when goodput sinks below the floor, and the flight
+      recorder dumps a bundle (with the step-phase breakdown in
+      goodput.json) on the transition.
+    """
+    server = ExpositionServer(host=host, port=port,
+                              registry=registry).start()
+    if goodput_floor is not None:
+        from .slo import get_engine, trainer_objectives
+        engine = get_engine()
+        have = {o.name for o in engine.objectives}
+        for obj in trainer_objectives(goodput_floor=goodput_floor):
+            if obj.name not in have:
+                engine.objectives.append(obj)
+    if registry_address:
+        from ..io.registry import report_server_to_registry
+        if process_id is None:
+            import sys
+            process_id = 0
+            if "jax" in sys.modules:
+                try:
+                    import jax
+                    process_id = jax.process_index()
+                except Exception:  # noqa: BLE001 - no backend: leader
+                    process_id = 0
+        report_server_to_registry(registry_address, name, host, server.port,
+                                  process_id=process_id, num_partitions=0,
+                                  kind="trainer")
+    return server
+
+
 # ---------------------------------------------------------------- merging
 def merge_states(states: list) -> dict:
     """Merge raw `export_state()` dicts: counters/timings sum, histogram
@@ -341,7 +464,8 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
                    timeout: float = 10.0,
                    skip_unreachable: bool = True,
                    window: Optional[float] = None,
-                   slo: bool = False) -> ClusterSnapshot:
+                   slo: bool = False,
+                   kind: Optional[str] = None) -> ClusterSnapshot:
     """Pull `/metrics.json` from every worker the `ServiceRegistry` at
     `registry_address` knows (optionally one service `name`) and merge.
     A worker that died between registering and the scrape is skipped (its
@@ -352,7 +476,11 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
     covers only each worker's last N seconds (bucket counts still sum
     elementwise; percentiles recompute from the merged windowed buckets).
     `slo=True` also pulls each worker's `/slo` verdict and merges them
-    with `telemetry.slo.merge_verdicts` (counts sum, burns recompute)."""
+    with `telemetry.slo.merge_verdicts` (counts sum, burns recompute).
+    `kind` scrapes only services of that registry kind (``"serving"`` /
+    ``"trainer"``) — no probing; the default merges both, which is
+    well-defined because trainer gauges (goodput) keep max and step
+    histograms bucket-sum exactly like every other metric."""
     from ..io.registry import ServiceInfo, list_services
     if name is not None:
         infos = list_services(registry_address, name, timeout=timeout)
@@ -360,6 +488,9 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
         with urllib.request.urlopen(registry_address + "/services",
                                     timeout=timeout) as resp:
             infos = [ServiceInfo(**d) for d in json.loads(resp.read())]
+    if kind is not None:
+        infos = [i for i in infos
+                 if getattr(i, "kind", "serving") == kind]
     metrics_path = "/metrics.json"
     if window is not None:
         metrics_path += f"?window={float(window):g}"

@@ -64,6 +64,8 @@ DATA_PREFETCH_STALLS = "data.prefetch.stalls"
 DATA_PREFETCH_FULL = "data.prefetch.full"
 PLAN_COMPILES = "plan.compiles"
 PLAN_RECOMPILES = "plan.recompiles"
+PLAN_COLLECTIVE_OPS = "plan.collective_ops"
+PLAN_COLLECTIVE_BYTES = "plan.collective_bytes"
 SERVING_PLAN_EVICTIONS = "serving.plan.evictions"
 TELEMETRY_BUNDLE_DUMPS = "telemetry.bundle.dumps"
 TELEMETRY_BUNDLE_SUPPRESSED = "telemetry.bundle.suppressed"
@@ -123,6 +125,10 @@ COUNTERS = {
                    "(telemetry.perf compile log)",
     PLAN_RECOMPILES: "a (fingerprint, shape bucket) compiled AGAIN — "
                      "steady-state serving pins this to zero",
+    PLAN_COLLECTIVE_OPS: "collective instructions (all-reduce, "
+                         "collective-permute, ...) in recorded executables",
+    PLAN_COLLECTIVE_BYTES: "per-device collective payload bytes in "
+                           "recorded executables (COMM_TRAFFIC account)",
     SERVING_PLAN_EVICTIONS: "compiled plans evicted (LRU) from the "
                             "bounded plan cache",
     TELEMETRY_BUNDLE_DUMPS: "flight-recorder debug bundles written",
@@ -143,6 +149,10 @@ CLUSTER_RESUME_EPOCH = "cluster.resume_epoch"
 DEVICE_MEM_BYTES_IN_USE = "device.mem.bytes_in_use"
 DEVICE_MEM_PEAK_BYTES = "device.mem.peak_bytes"
 HOST_RSS_BYTES = "host.rss_bytes"
+TRAIN_GOODPUT = "train.goodput"
+TRAIN_MFU = "train.mfu"
+TRAIN_LOST_SECONDS = "train.lost_seconds"
+TRAIN_STRAGGLERS = "train.stragglers"
 
 GAUGES = {
     SERVING_QUEUE_DEPTH: "partition queue depth at last enqueue",
@@ -155,6 +165,15 @@ GAUGES = {
                              "(absent where memory_stats() is)",
     DEVICE_MEM_PEAK_BYTES: "peak bytes in use summed over local devices",
     HOST_RSS_BYTES: "host process resident set size (bytes)",
+    TRAIN_GOODPUT: "productive fraction of training wall clock "
+                   "(1 - (data-wait + checkpoint-stall + lost) / wall)",
+    TRAIN_MFU: "model-flops utilization: flops_per_step * steps / "
+               "(wall * peak_flops); absent when either flops side is "
+               "unknown",
+    TRAIN_LOST_SECONDS: "cumulative lost training seconds (restart/replay "
+                        "rewinds, injected stalls, failed step attempts)",
+    TRAIN_STRAGGLERS: "hosts currently flagged by straggler detection "
+                      "(windowed step p50 beyond threshold x fleet median)",
     "device{ordinal}.mem.bytes_in_use": "per-device bytes in use "
                                         "(memory_stats)",
     "device{ordinal}.mem.peak_bytes": "per-device peak bytes in use "
@@ -170,9 +189,14 @@ CHECKPOINT_SUBMIT = "checkpoint.submit"
 CHECKPOINT_SNAPSHOT = "checkpoint.snapshot"
 CHECKPOINT_WRITE = "checkpoint.write"
 PLAN_COMPILE = "plan.compile"
+TRAIN_STEP_WALL = "train.step.wall"
 
 HISTOGRAMS = {
     PLAN_COMPILE: "plan build / AOT jit compile duration (ms)",
+    TRAIN_STEP_WALL: "one training step's wall clock (ms) — the "
+                     "straggler detector's windowed p50 source",
+    "train.step.{phase}": "per-step phase time (ms): data_wait / host / "
+                          "device / checkpoint / lost (StepClock)",
     SERVING_REQUEST_QUEUE: "ingress enqueue -> worker drain, per request "
                            "(ms)",
     SERVING_REQUEST_TRANSFORM: "transform duration per batch (ms)",
@@ -244,10 +268,14 @@ FAULT_INJECTED_EVENT = "fault.injected"
 TRAIN_RESUME_EVENT = "train.resume"
 TRAIN_RESTART_EVENT = "train.restart"
 TRAIN_PREEMPTED_EVENT = "train.preempted"
+TRAIN_STRAGGLER_EVENT = "train.straggler"
 TELEMETRY_BUNDLE_EVENT = "telemetry.bundle"
 
 EVENTS = {
     FAULT_INJECTED_EVENT: "one FaultInjector firing (site, index, kind)",
+    TRAIN_STRAGGLER_EVENT: "a host's windowed step p50 deviated beyond "
+                           "the straggler threshold (host, p50, fleet "
+                           "median attrs)",
     TELEMETRY_BUNDLE_EVENT: "one flight-recorder bundle written (reason, "
                             "path)",
     TRAIN_RESUME_EVENT: "supervisor resumed from a checkpoint",
@@ -308,3 +336,8 @@ def device_mem_in_use(ordinal: int) -> str:
 def device_mem_peak(ordinal: int) -> str:
     """device{ordinal}.mem.peak_bytes — per-device peak gauge."""
     return f"device{ordinal}.mem.peak_bytes"
+
+
+def train_step_phase(phase: str) -> str:
+    """train.step.{phase} — per-phase step-time histogram."""
+    return f"train.step.{phase}"

@@ -22,6 +22,18 @@ those gaps (docs/observability.md "Performance observability"):
   bytes) from a compiled XLA executable, degrading field-by-field where
   a backend omits them (the CPU backend reports cost but not
   `memory_stats`; TPU reports both).
+- **Collective traffic** (`collective_traffic` and the `collectives`
+  field of `executable_analysis`): per-executable collective ops/bytes
+  (all-reduce, all-gather, reduce-scatter, collective-permute,
+  all-to-all) parsed from the COMPILED module's HLO — the COMM_TRAFFIC
+  account promoted from the bench-only `__graft_entry__` harness into
+  the compile log, so the numbers ride every recorded fit and merge
+  fleet-wide through the `plan.collective_{ops,bytes}` counters.
+- **AotCache**: a per-shape AOT jit cache for training-loop executables
+  (the distributed GBDT tree/chunk steps): the FIRST call per shape
+  signature lowers and compiles through the compile log — cost analysis
+  and collective traffic recorded on the executable actually used, no
+  double compile — and later calls dispatch to the cached executable.
 - **Resource gauges** (`sample_resource_gauges`): per-device
   `memory_stats()` bytes-in-use/peak and host RSS into gauges, sampled
   on every exposition scrape — fleet scrapes carry memory headroom next
@@ -119,6 +131,15 @@ class CompileLog:
         reg.inc(tnames.PLAN_COMPILES)
         if recompile:
             reg.inc(tnames.PLAN_RECOMPILES)
+        colls = (analysis or {}).get("collectives") or {}
+        if colls:
+            # COMM_TRAFFIC-style account rides the fleet-mergeable
+            # counters (sums across workers); per-kind detail stays on
+            # the record itself
+            reg.inc(tnames.PLAN_COLLECTIVE_OPS,
+                    sum(int(v.get("ops", 0)) for v in colls.values()))
+            reg.inc(tnames.PLAN_COLLECTIVE_BYTES,
+                    sum(int(v.get("bytes", 0)) for v in colls.values()))
         reg.observe_ms(tnames.PLAN_COMPILE, float(seconds) * 1000.0)
         tracer = self._tracer if self._tracer is not None else get_tracer()
         tracer.record(tnames.PLAN_COMPILE_SPAN,
@@ -183,6 +204,45 @@ def compile_stats() -> dict:
     return _default_log.stats()
 
 
+# ------------------------------------------------------- collective traffic
+_HLO_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+              "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+              "pred": 1}
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*((?:\w+\[[^\]]*\][^ ]*|\([^)]*\)))\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+
+
+def collective_traffic(hlo_text: str) -> dict:
+    """Count collective ops and their payload bytes in compiled HLO:
+    {kind: {"ops": n, "bytes": b}}. Bytes are per-device
+    per-instruction-execution (instructions inside loops count once —
+    pair with analytic per-step formulas where a loop trip count
+    matters). Promoted from the bench-only `__graft_entry__` harness so
+    every recorded executable carries the COMM_TRAFFIC account."""
+    out: dict = {}
+    for shapes, kind in _COLLECTIVE_RE.findall(hlo_text):
+        nbytes = 0
+        for dt, dims in _SHAPE_RE.findall(shapes):
+            if dt not in _HLO_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _HLO_BYTES[dt]
+        ent = out.setdefault(kind, {"ops": 0, "bytes": 0})
+        ent["ops"] += 1
+        ent["bytes"] += nbytes
+    return out
+
+
 # ------------------------------------------------------ executable analysis
 _COST_FIELDS = (("flops", "flops"),
                 ("bytes accessed", "bytes_accessed"),
@@ -195,12 +255,15 @@ _MEM_FIELDS = (("generated_code_size_in_bytes", "generated_code_bytes"),
                ("temp_size_in_bytes", "temp_bytes"))
 
 
-def executable_analysis(compiled) -> dict:
+def executable_analysis(compiled, collectives: bool = True) -> dict:
     """Cost/memory footprint of a compiled XLA executable, field by
     field, skipping anything the backend omits (the contract: NEVER
     raise, possibly return {}). `peak_bytes` is derived as the sum of
     the reported argument/output/temp/code components — a lower bound
-    on live bytes, labeled by construction rather than guessed."""
+    on live bytes, labeled by construction rather than guessed.
+    `collectives` (default on) also parses the optimized HLO for the
+    per-kind collective ops/bytes account (`collectives` key, only
+    present when the module actually contains collectives)."""
     out: dict = {}
     try:
         ca = compiled.cost_analysis()
@@ -229,6 +292,13 @@ def executable_analysis(compiled) -> dict:
                     have_peak = True
         if have_peak:
             out["peak_bytes"] = peak
+    if collectives:
+        try:
+            traffic = collective_traffic(compiled.as_text())
+        except Exception:  # noqa: BLE001 - a backend without HLO text
+            traffic = {}
+        if traffic:
+            out["collectives"] = traffic
     return out
 
 
@@ -260,6 +330,92 @@ def compile_with_analysis(fn, *args, label: Optional[str] = None,
     (log if log is not None else _default_log).record(
         fp, bucket, seconds, analysis=analysis, label=label or fp)
     return compiled
+
+
+class AotCache:
+    """Per-shape AOT jit cache that records every compile it performs.
+
+    The serving plan cache gave inference zero-recompile telemetry; the
+    training loops still compiled through bare `jax.jit`, invisible to
+    the compile log. Wrapping a step function in an AotCache keeps ONE
+    compile per (shape, dtype, sharding) signature — the first call per
+    signature lowers and compiles (jit -> lower -> compile), records the
+    executable's cost analysis AND collective traffic into the compile
+    log, and every later call dispatches straight to the cached
+    executable. A signature compiled twice (cache pressure, a renamed
+    fingerprint) counts `plan.recompiles`, same discipline as serving.
+
+        step = AotCache(train_step_fn, label="gbdt.tree.data_parallel")
+        tree, delta = step(bins, grad, hess, fmask, count_w)
+    """
+
+    def __init__(self, fn, label: str, fingerprint: Optional[str] = None,
+                 log: Optional["CompileLog"] = None, registry=None,
+                 max_entries: int = 32, **jit_kwargs):
+        self._fn = fn
+        self.label = label
+        self.fingerprint = fingerprint or label
+        self._log = log
+        self._registry = registry
+        self._jit_kwargs = jit_kwargs
+        self._max = max(int(max_entries), 1)
+        self._lock = threading.Lock()
+        self._compiled: OrderedDict = OrderedDict()
+        self._jitted = None
+
+    @staticmethod
+    def _sig(args) -> tuple:
+        sig = []
+        for a in args:
+            shape = getattr(a, "shape", None)
+            if shape is None:
+                sig.append(("py", type(a).__name__))
+                continue
+            sig.append((tuple(shape), str(getattr(a, "dtype", "?")),
+                        getattr(a, "sharding", None)))
+        return tuple(sig)
+
+    @staticmethod
+    def _bucket(args) -> str:
+        shapes = []
+        for a in args:
+            shape = getattr(a, "shape", None)
+            shapes.append("x".join(str(d) for d in shape)
+                          if shape is not None else type(a).__name__)
+        return ",".join(shapes) or "scalar"
+
+    def __call__(self, *args):
+        key = self._sig(args)
+        with self._lock:
+            compiled = self._compiled.get(key)
+            if compiled is not None:
+                self._compiled.move_to_end(key)
+        if compiled is None:
+            compiled = self._compile(key, args)
+        return compiled(*args)
+
+    def _compile(self, key, args):
+        import jax
+        with self._lock:
+            if self._jitted is None:
+                self._jitted = jax.jit(self._fn, **self._jit_kwargs)
+            jitted = self._jitted
+        # compile OUTSIDE the lock (minutes-long XLA runs must not
+        # serialize an unrelated shape's dispatch); two threads racing
+        # the same key cost one duplicate compile, last one wins
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*args).compile()
+        seconds = time.perf_counter() - t0
+        analysis = executable_analysis(compiled)
+        log = self._log if self._log is not None else _default_log
+        log.record(self.fingerprint, self._bucket(args), seconds,
+                   analysis=analysis, label=self.label,
+                   registry=self._registry)
+        with self._lock:
+            self._compiled[key] = compiled
+            while len(self._compiled) > self._max:
+                self._compiled.popitem(last=False)
+        return compiled
 
 
 # -------------------------------------------------------------- bench math
@@ -506,6 +662,11 @@ class FlightRecorder:
                                     "per_key": log.per_key(),
                                     "records": log.records()})
             _json("memory.json", sample_resource_stats())
+            # the training-side step-phase breakdown (empty {} on pure
+            # serving processes): a burning TRAINING run's bundle then
+            # says where its steps' time went
+            from .goodput import default_snapshot
+            _json("goodput.json", default_snapshot())
             manifest = {"reason": str(reason), "tag": tag, "seq": seq,
                         "pid": os.getpid(), "t": wall_now(), "path": path,
                         "files": files, "tracer": tracer.stats(),

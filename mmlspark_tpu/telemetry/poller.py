@@ -44,11 +44,16 @@ class TelemetryPoller:
     def __init__(self, registry_address: str, name: Optional[str] = None,
                  interval_s: float = 10.0, window_s: Optional[float] = 60.0,
                  history: int = 720, timeout: float = 5.0,
-                 slo: bool = True, flight_on_burn: bool = False):
+                 slo: bool = True, flight_on_burn: bool = False,
+                 kind: Optional[str] = None):
         if interval_s <= 0.0:
             raise ValueError("interval_s must be > 0")
         self.registry_address = registry_address
         self.name = name
+        # None polls every registered endpoint (serving AND trainers —
+        # their registry `kind` entries make the mix explicit); set to
+        # "serving"/"trainer" to watch one class
+        self.kind = kind
         self.interval_s = float(interval_s)
         self.window_s = window_s
         self.timeout = float(timeout)
@@ -97,7 +102,7 @@ class TelemetryPoller:
         see the error."""
         snap = scrape_cluster(self.registry_address, name=self.name,
                               timeout=self.timeout, window=self.window_s,
-                              slo=self.slo)
+                              slo=self.slo, kind=self.kind)
         sample = {"t": wall_now(),
                   "workers": snap.merged.get("telemetry.scrape.workers", 0),
                   "window_s": snap.merged.get("telemetry.scrape.window_s"),

@@ -44,13 +44,18 @@ from . import names as tnames
 
 LATENCY = "latency"
 ERROR_RATE = "error_rate"
+GOODPUT = "goodput"
 
 
 class Objective(NamedTuple):
     """One declared objective. `kind` is `latency` (histogram `metric`,
-    `quantile` of requests must finish under `threshold_ms`) or
+    `quantile` of requests must finish under `threshold_ms`),
     `error_rate` (counter `metric` over counter `total_metric` must stay
-    under `budget`). `window_s` is the short evaluation window."""
+    under `budget`), or `goodput` (gauge `metric` must stay at or above
+    `floor` — the training-side floor on productive wall-clock
+    fraction). `window_s` is the short evaluation window; a gauge
+    objective reads the same last-set value in both windows (gauges
+    carry no shards — the StepClock already windows its own inputs)."""
     name: str
     kind: str
     metric: str
@@ -59,6 +64,7 @@ class Objective(NamedTuple):
     quantile: float = 99.0         # latency only
     budget: float = 0.01           # error_rate only
     total_metric: str = ""         # error_rate only
+    floor: float = 0.0             # goodput only
 
 
 def default_objectives() -> list:
@@ -72,6 +78,20 @@ def default_objectives() -> list:
                   metric=tnames.SERVING_REQUEST_ERRORS,
                   total_metric=tnames.SERVING_REQUEST_TOTAL,
                   budget=0.01, window_s=60.0),
+    ]
+
+
+def trainer_objectives(goodput_floor: float = 0.9,
+                       window_s: float = 60.0) -> list:
+    """The training-tier default: goodput (productive/wall, the
+    `train.goodput` gauge the StepClock publishes) must stay at or above
+    `goodput_floor`. Trainers mount it with
+    `configure(default_objectives() + trainer_objectives())` or through
+    `telemetry.exposition.expose_trainer(goodput_floor=...)`."""
+    return [
+        Objective(name="train.goodput.floor", kind=GOODPUT,
+                  metric=tnames.TRAIN_GOODPUT, floor=goodput_floor,
+                  window_s=window_s),
     ]
 
 
@@ -113,6 +133,16 @@ class SLOEngine:
         return {"window_s": window_s, "count": state["count"],
                 "violations": violations, "value_ms": value}
 
+    def _gauge_window(self, obj: Objective, window_s: float) -> dict:
+        # a gauge is a last-set value, not a shard ring: both windows
+        # read the same number (the StepClock's goodput is already a
+        # cumulative-with-recent-median signal). peek, never create —
+        # a never-trained process reads as no-data, not goodput 0.
+        value = self._registry.peek_gauge(obj.metric)
+        if value is None:
+            return {"window_s": window_s, "no_data": True}
+        return {"window_s": window_s, "value": float(value)}
+
     def _error_window(self, obj: Objective, window_s: float) -> dict:
         total = self._registry.peek_counter(obj.total_metric)
         if total is None or total.window is None:
@@ -144,6 +174,8 @@ class SLOEngine:
             for w in (obj.window_s, obj.window_s * self.long_factor):
                 if obj.kind == LATENCY:
                     m = self._latency_window(obj, w)
+                elif obj.kind == GOODPUT:
+                    m = self._gauge_window(obj, w)
                 else:
                     m = self._error_window(obj, w)
                 windows.append(_finish_window(obj._asdict(), m))
@@ -171,6 +203,17 @@ def _finish_window(obj: dict, m: dict) -> dict:
     """Rate/burn math for one window measurement — shared by the live
     engine and the fleet merge so both always agree."""
     m = dict(m)
+    if obj["kind"] == GOODPUT:
+        # burn > 1 exactly when the gauge sits below the floor; no data
+        # (never trained) burns 0 — absence of evidence is not a burn
+        value = m.get("value")
+        floor = obj.get("floor", 0.0)
+        if value is None:
+            m["rate"], m["burn_rate"] = 0.0, 0.0
+        else:
+            m["rate"] = value
+            m["burn_rate"] = floor / max(value, 1e-9) if floor > 0 else 0.0
+        return m
     if obj["kind"] == LATENCY:
         count, violations = m.get("count", 0), m.get("violations", 0)
         allowed = max(1.0 - obj["quantile"] / 100.0, 1e-9)
@@ -217,6 +260,12 @@ def merge_verdicts(verdicts: list) -> Optional[dict]:
                 if "value_ms" in wb:
                     wa["value_ms_max"] = max(wa.get("value_ms_max", 0.0),
                                              wb["value_ms"])
+                if "value" in wb:
+                    # gauge objectives (goodput floor): the WORST worker
+                    # is the fleet verdict — min, never averaged
+                    wa["value"] = (min(wa["value"], wb["value"])
+                                   if "value" in wa else wb["value"])
+                    wa.pop("no_data", None)
     objectives = []
     for name in order:
         agg = by_name[name]

@@ -1,0 +1,622 @@
+"""Training-loop observability (ISSUE 9): goodput/MFU accounting,
+step-phase decomposition, lost-work accounting across restart/resume,
+collective-traffic compile records, straggler detection, the
+goodput-floor SLO -> flight-recorder path, and the trainer scrape
+surface merging with the serving fleet."""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.reliability import (FaultInjector, RetryPolicy,
+                                      TrainingSupervisor)
+from mmlspark_tpu.reliability.metrics import (MetricsRegistry,
+                                              reliability_metrics)
+from mmlspark_tpu.telemetry import names as tnames
+from mmlspark_tpu.telemetry import slo as tslo
+from mmlspark_tpu.telemetry.goodput import (StepClock, StragglerDetector,
+                                            get_clock)
+from mmlspark_tpu.telemetry import perf as tperf
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ StepClock math
+def test_step_clock_phase_decomposition():
+    reg = MetricsRegistry()
+    clock = StepClock(registry=reg, install=False)
+    with clock.step(0):
+        clock.note("data_wait", 0.010)
+        clock.note("device", 0.020)
+        time.sleep(0.04)
+    clock.note("checkpoint", 0.005)          # out-of-step: extends wall
+    snap = clock.snapshot()
+    assert snap["steps"] == 1
+    assert snap["wall_s"] >= 0.045
+    ph = snap["phases"]
+    assert ph["data_wait_s"] == pytest.approx(0.010)
+    assert ph["device_s"] == pytest.approx(0.020)
+    assert ph["checkpoint_s"] == pytest.approx(0.005)
+    assert ph["lost_s"] == 0.0
+    # host = wall - attributed phases, never negative
+    assert ph["host_s"] == pytest.approx(
+        snap["wall_s"] - 0.035, abs=1e-6)
+    # goodput excludes data_wait + checkpoint (no lost time here)
+    assert snap["goodput"] == pytest.approx(
+        1.0 - 0.015 / snap["wall_s"], abs=1e-6)
+    # hist publication: wall + each noted phase
+    assert reg.peek_histogram(tnames.TRAIN_STEP_WALL).count == 1
+    assert reg.peek_histogram("train.step.data_wait").count == 1
+    assert reg.gauge(tnames.TRAIN_GOODPUT) == pytest.approx(
+        snap["goodput"], abs=1e-4)
+
+
+def test_step_clock_failed_attempt_and_rewind_become_lost():
+    clock = StepClock(registry=MetricsRegistry(), install=False)
+    with clock.step(0):
+        time.sleep(0.01)
+    clock.marked()
+    with clock.step(1):
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError):
+        with clock.step(2):
+            time.sleep(0.01)
+            raise RuntimeError("boom")
+    clock.rewound()     # step 1 (post-mark) re-executes: its wall is lost
+    snap = clock.snapshot()
+    # lost = failed attempt (~10ms) + rewound step 1 (~10ms)
+    assert snap["phases"]["lost_s"] >= 0.018
+    assert snap["goodput"] < 1.0
+
+
+def test_step_clock_mfu_and_degrade():
+    clock = StepClock(registry=MetricsRegistry(), install=False,
+                      flops_per_step=1e9, peak_flops=1e12)
+    assert clock.mfu() is None          # no steps yet -> wall 0
+    with clock.step(0):
+        time.sleep(0.01)
+    mfu = clock.mfu()
+    assert mfu is not None and 0.0 < mfu < 1.0
+    # degrade: unknown flops -> None, never a guessed number
+    bare = StepClock(registry=MetricsRegistry(), install=False)
+    with bare.step(0):
+        pass
+    assert bare.mfu() is None and bare.snapshot()["mfu"] is None
+
+
+# --------------------------------------------- lost-work accounting (sup)
+def _toy_supervisor(directory, reg, clock, faults=None, step_s=0.008, **kw):
+    state = {"x": np.zeros(3, np.float64)}
+    kw.setdefault("checkpoint_every", 2)
+    sup = TrainingSupervisor(
+        directory, lambda: {"x": state["x"].copy()},
+        lambda p: state.update(x=np.asarray(p["x"]).copy()),
+        metrics=reg, faults=faults, step_clock=clock, **kw)
+
+    def step(k):
+        time.sleep(step_s)
+        state["x"] = state["x"] + (k + 1)
+        return float(state["x"][0])
+
+    return sup, step, state
+
+
+@pytest.mark.chaos
+def test_uninterrupted_run_pins_goodput_near_one(tmp_path):
+    reg = MetricsRegistry()
+    clock = StepClock(registry=reg, install=False)
+    sup, step, _ = _toy_supervisor(str(tmp_path / "ck"), reg, clock,
+                                   checkpoint_every=4)
+    sup.run(step, 8)
+    sup.close()
+    snap = clock.snapshot()
+    assert snap["phases"]["lost_s"] == 0.0
+    assert snap["goodput"] > 0.9        # ~1.0: steps dominate the stalls
+    assert reg.gauge(tnames.TRAIN_LOST_SECONDS) == 0.0
+    assert reg.peek_histogram(tnames.TRAIN_STEP_WALL).count == 8
+
+
+@pytest.mark.chaos
+def test_seeded_restart_lands_lost_seconds_and_goodput_below_one(tmp_path):
+    """Satellite: a seeded in-run crash-restart books the replayed wall
+    in train.lost_seconds and goodput < 1.0 — deterministically, same
+    schedule as the supervisor bit-identity tests."""
+    reg = MetricsRegistry()
+    clock = StepClock(registry=reg, install=False)
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "train.step5", "kind": "crash", "at": [0]}])
+    sup, step, _ = _toy_supervisor(str(tmp_path / "ck"), reg, clock,
+                                   faults=inj)
+    out = sup.run(step, 8)
+    sup.close()
+    assert len(out) == 8
+    lost = reg.gauge(tnames.TRAIN_LOST_SECONDS)
+    assert lost > 0.0
+    snap = clock.snapshot()
+    assert snap["phases"]["lost_s"] == pytest.approx(lost, rel=1e-3)
+    uninterrupted_like = 1.0 - (snap["phases"]["data_wait_s"]
+                                + snap["phases"]["checkpoint_s"]) \
+        / snap["wall_s"]
+    assert snap["goodput"] < uninterrupted_like < 1.0 + 1e-9
+
+
+@pytest.mark.chaos
+def test_kill_resume_carries_lost_accounting_through_checkpoint(tmp_path):
+    """The clock state rides the checkpoint payload: a run that dies
+    (retry budget exhausted after a restart) and is resumed by a FRESH
+    supervisor keeps the prior run's lost seconds — cumulative goodput
+    spans the kill instead of resetting to 1.0."""
+    d = str(tmp_path / "ck")
+    reg1 = MetricsRegistry()
+    clock1 = StepClock(registry=reg1, install=False)
+    # crash at step 3 once (restart books lost wall; the step-4 mark
+    # then persists it), then step 6 crashes every attempt — the retry
+    # budget (one restart) is spent, so the run dies after a checkpoint
+    # that already carries lost > 0
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "train.step3", "kind": "crash", "at": [0]},
+        {"site": "train.step6", "kind": "crash", "prob": 1.0}])
+    sup, step, _ = _toy_supervisor(d, reg1, clock1, faults=inj,
+                                   retry_policy=RetryPolicy(max_attempts=2))
+    with pytest.raises(Exception, match="injected crash"):
+        sup.run(step, 8)
+    sup.close()
+    lost_before = clock1.snapshot()["phases"]["lost_s"]
+    assert lost_before > 0.0
+
+    reg2 = MetricsRegistry()
+    clock2 = StepClock(registry=reg2, install=False)
+    sup2, step2, _ = _toy_supervisor(d, reg2, clock2)
+    out = sup2.run(step2, 8)
+    sup2.close()
+    assert len(out) == 8
+    snap2 = clock2.snapshot()
+    # the resumed clock restored the dead run's accounting at its last
+    # mark (which already included the restart's lost wall)
+    assert snap2["phases"]["lost_s"] > 0.0
+    assert snap2["goodput"] < 1.0
+    assert reg2.gauge(tnames.TRAIN_LOST_SECONDS) > 0.0
+
+
+# -------------------------------------------------- heartbeat stats exchange
+def test_heartbeat_stats_roundtrip_and_read_all(tmp_path):
+    from mmlspark_tpu.parallel.cluster import Heartbeat
+    hb0 = Heartbeat(str(tmp_path), process_id=0)
+    hb1 = Heartbeat(str(tmp_path), process_id=1)
+    hb0.beat(3, stats={"step_p50_ms": 2.0, "steps": 8, "goodput": 0.99})
+    hb1.beat(3, stats={"step_p50_ms": 40.0, "steps": 8, "goodput": 0.6})
+    rows = hb0.read_all()
+    assert [r["process_id"] for r in rows] == [0, 1]
+    assert rows[1]["stats"]["step_p50_ms"] == 40.0
+    # beats without stats stay readable (wire compat)
+    hb0.beat(4)
+    assert "stats" not in hb0.read()
+
+
+def test_straggler_detector_flags_deviating_host(tmp_path):
+    from mmlspark_tpu.parallel.cluster import Heartbeat
+    reg = MetricsRegistry()
+    tracer = telemetry.Tracer(sample=1.0)
+    hb0 = Heartbeat(str(tmp_path), process_id=0)
+    hb1 = Heartbeat(str(tmp_path), process_id=1)
+    hb0.beat(5, stats={"step_p50_ms": 2.0, "steps": 8, "goodput": 1.0})
+    hb1.beat(5, stats={"step_p50_ms": 200.0, "steps": 8, "goodput": 0.1})
+    det = StragglerDetector(hb0, threshold=1.5, registry=reg,
+                            tracer=tracer)
+    flagged = det.check()
+    assert [s["process_id"] for s in flagged] == [1]
+    assert reg.gauge(tnames.TRAIN_STRAGGLERS) == 1
+    events = tracer.finished(tnames.TRAIN_STRAGGLER_EVENT)
+    assert len(events) == 1 and events[0]["attrs"]["host"] == 1
+    # transition semantics: a second pass re-flags the gauge, not the event
+    det.check()
+    assert len(tracer.finished(tnames.TRAIN_STRAGGLER_EVENT)) == 1
+    # host recovers -> gauge clears
+    hb1.beat(6, stats={"step_p50_ms": 2.2, "steps": 12, "goodput": 0.99})
+    assert det.check() == []
+    assert reg.gauge(tnames.TRAIN_STRAGGLERS) == 0
+
+
+# --------------------------- acceptance: delay fault -> straggler -> bundle
+@pytest.mark.chaos
+def test_delay_fault_straggler_burns_goodput_slo_dumps_bundle(
+        tmp_path, monkeypatch):
+    """The acceptance path, end to end and seed-deterministic: a delay
+    fault on ONE host of a two-host (heartbeat-file) run emits
+    `train.straggler`, sinks that host's goodput below the SLO floor,
+    and the burning verdict makes the flight recorder dump a bundle
+    whose goodput.json carries the step-phase breakdown."""
+    hb_dir = str(tmp_path / "hb")
+    from mmlspark_tpu.parallel.cluster import Heartbeat
+    tracer = telemetry.get_tracer()
+    tracer.configure(sample=1.0)
+    tracer.clear()
+    monkeypatch.setattr(tperf, "_recorder", None)   # fresh burn latch
+    bundles = tmp_path / "bundles"
+    tperf.configure_flight_recorder(bundle_dir=str(bundles),
+                                    min_interval_s=0.0, max_bundles=4)
+    try:
+        # host 0: healthy run, beats every step
+        reg0 = MetricsRegistry()
+        clock0 = StepClock(registry=reg0, install=False)
+        hb0 = Heartbeat(hb_dir, process_id=0)
+        sup0, step0, _ = _toy_supervisor(
+            str(tmp_path / "ck0"), reg0, clock0, heartbeat=hb0,
+            checkpoint_every=2, step_s=0.015, handle_signals=False)
+        sup0.run(step0, 6)
+        sup0.close()
+        # a clean finish clears its heartbeat; re-beat so host 0 looks
+        # like the live concurrent peer it would be in a real fleet
+        hb0.beat(6, stats=clock0.beat_stats())
+
+        # host 1: every step pays a seeded 200ms injected stall
+        reg1 = MetricsRegistry()
+        clock1 = StepClock(registry=reg1)   # installed: bundle reads it
+        hb1 = Heartbeat(hb_dir, process_id=1)
+        inj = FaultInjector(seed=3, rules=[
+            {"site": "train.step*", "kind": "delay", "param": 0.2,
+             "prob": 1.0}])
+        sup1, step1, _ = _toy_supervisor(
+            str(tmp_path / "ck1"), reg1, clock1, heartbeat=hb1,
+            faults=inj, checkpoint_every=1, step_s=0.002,
+            handle_signals=False)
+        sup1.run(step1, 6)
+        sup1.close()
+
+        # the straggler event fired on host 1's own beat (its detector
+        # saw host 0's file) — deterministic under the seeded schedule
+        events = tracer.finished(tnames.TRAIN_STRAGGLER_EVENT)
+        assert events and events[-1]["attrs"]["host"] == 1
+        assert reg1.gauge(tnames.TRAIN_STRAGGLERS) == 1
+        # injected stalls are lost time: goodput deep under the floor
+        assert reg1.gauge(tnames.TRAIN_GOODPUT) < 0.2
+
+        engine = tslo.SLOEngine(
+            objectives=tslo.trainer_objectives(goodput_floor=0.9),
+            registry=reg1)
+        verdict = engine.verdict()
+        assert verdict["burning"] and not verdict["ok"]
+        obj = verdict["objectives"][0]
+        assert obj["windows"][0]["burn_rate"] > 1.0
+
+        bundle_dirs = sorted(bundles.iterdir())
+        assert bundle_dirs, "burning verdict did not dump a bundle"
+        goodput_json = json.loads(
+            (bundle_dirs[-1] / "goodput.json").read_text())
+        assert goodput_json["phases"]["lost_s"] > 1.0   # 6 x 0.2s stalls
+        assert goodput_json["goodput"] < 0.2
+        manifest = json.loads(
+            (bundle_dirs[-1] / "manifest.json").read_text())
+        assert manifest["burning"] and "goodput.json" in manifest["files"]
+
+        # healthy host under the same objective: ok, no burn
+        healthy = tslo.SLOEngine(
+            objectives=tslo.trainer_objectives(goodput_floor=0.9),
+            registry=reg0).verdict(notify=False)
+        assert healthy["ok"] and not healthy["burning"]
+    finally:
+        tperf.configure_flight_recorder(bundle_dir="")
+        monkeypatch.setattr(tperf, "_recorder", None)
+        tracer.configure(sample=0.0)
+        tracer.clear()
+
+
+def test_goodput_objective_no_data_is_ok_and_merge_keeps_min():
+    reg = MetricsRegistry()
+    engine = tslo.SLOEngine(
+        objectives=tslo.trainer_objectives(goodput_floor=0.9),
+        registry=reg)
+    v = engine.verdict(notify=False)
+    assert v["ok"] and not v["burning"]       # never trained: no burn
+    reg.set_gauge(tnames.TRAIN_GOODPUT, 0.95)
+    ok = engine.verdict(notify=False)
+    assert ok["ok"]
+    reg.set_gauge(tnames.TRAIN_GOODPUT, 0.5)
+    burn = engine.verdict(notify=False)
+    assert burn["burning"]
+    # fleet merge: the WORST worker's goodput drives the merged burn
+    merged = tslo.merge_verdicts([ok, burn])
+    w = merged["objectives"][0]["windows"][0]
+    assert w["value"] == pytest.approx(0.5)
+    assert merged["burning"]
+    merged_ok = tslo.merge_verdicts([ok, ok])
+    assert not merged_ok["burning"]
+
+
+# ------------------------------------------------- collective compile records
+def test_collective_traffic_parses_hlo_text():
+    hlo = """
+  %ar = f32[256,3]{1,0} all-reduce(f32[256,3]{1,0} %x), replica_groups={}
+  %cp = bf16[64]{0} collective-permute(bf16[64]{0} %y)
+  %ar2 = f32[8]{0} all-reduce-start(f32[8]{0} %z)
+"""
+    traffic = tperf.collective_traffic(hlo)
+    assert traffic["all-reduce"]["ops"] == 2
+    assert traffic["all-reduce"]["bytes"] == 256 * 3 * 4 + 8 * 4
+    assert traffic["collective-permute"] == {"ops": 1, "bytes": 128}
+
+
+def test_aot_cache_records_collectives_once_per_signature():
+    import jax
+    import jax.numpy as jnp
+    if jax.device_count() < 2:
+        pytest.skip("collective recording needs a multi-device mesh")
+    from jax.sharding import PartitionSpec as P
+    from mmlspark_tpu.parallel import DATA_AXIS, data_mesh
+    from mmlspark_tpu.parallel.shard import shard_map
+
+    mesh = data_mesh()
+    mapped = shard_map(lambda x: jax.lax.psum(x, DATA_AXIS), mesh=mesh,
+                       in_specs=(P(DATA_AXIS),), out_specs=P(),
+                       check_rep=False)
+    reg = MetricsRegistry()
+    log = tperf.CompileLog(registry=reg)
+    cache = tperf.AotCache(mapped, label="test.psum", log=log)
+    n = 8 * mesh.shape[DATA_AXIS]
+    x = jnp.arange(n, dtype=jnp.float32)
+    out = cache(x)
+    assert float(np.asarray(out)[0]) == float(np.arange(n).reshape(
+        mesh.shape[DATA_AXIS], -1).sum(0)[0])
+    rec = log.records()[-1]
+    colls = rec["analysis"]["collectives"]
+    assert colls["all-reduce"]["ops"] >= 1
+    assert colls["all-reduce"]["bytes"] > 0
+    assert reg.get(tnames.PLAN_COLLECTIVE_OPS) >= 1
+    assert reg.get(tnames.PLAN_COLLECTIVE_BYTES) > 0
+    # second same-shape call: cached executable, no recompile
+    cache(x + 1.0)
+    stats = log.stats()
+    assert stats["compiles"] == 1 and stats["recompiles"] == 0
+    # a new shape compiles (and records) again under the same fingerprint
+    cache(jnp.arange(2 * n, dtype=jnp.float32))
+    assert log.stats()["compiles"] == 2
+
+
+def test_distributed_tree_fn_leaves_collective_record():
+    import jax
+    import jax.numpy as jnp
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device mesh")
+    from mmlspark_tpu.models.gbdt.distributed import make_sharded_tree_fn
+    from mmlspark_tpu.models.gbdt.trainer import TreeConfig
+    from mmlspark_tpu.parallel import data_mesh
+
+    mesh = data_mesh()
+    n = 16 * jax.device_count()
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, 16, size=(n, 4)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    cfg = TreeConfig(n_features=4, n_bins=256, max_depth=2,
+                     min_data_in_leaf=1)
+    tree_fn = make_sharded_tree_fn(mesh, "data_parallel")
+    tree, delta = tree_fn(jnp.asarray(bins), jnp.asarray(grad),
+                          jnp.asarray(hess), jnp.ones(4, bool), cfg)
+    jax.block_until_ready(delta)
+    recs = [r for r in tperf.get_compile_log().records()
+            if r.get("label") == "gbdt.tree.data_parallel"]
+    assert recs, "distributed tree compile left no record"
+    colls = (recs[-1]["analysis"] or {}).get("collectives") or {}
+    # the histogram psum MUST be there — its absence means the
+    # "distributed" fit silently went local
+    assert colls.get("all-reduce", {}).get("bytes", 0) > 0
+
+
+# ------------------------------------------------- trainer scrape surface
+def _mini_serving():
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+    server = ServingServer(num_partitions=1).start()
+
+    def echo(bodies):
+        return [{"echo": json.loads(b)["x"]} for b in bodies]
+
+    query = ServingQuery(server, echo, mode="continuous").start()
+    return server, query
+
+
+def test_trainer_scrape_merges_with_serving_worker():
+    """Acceptance: scrape_cluster over a live trainer + serving worker
+    merges both — trainer goodput gauges keep max, step histograms
+    bucket-sum — with no serving-metric regressions, and `kind` targets
+    one class without probing."""
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    from mmlspark_tpu.telemetry.exposition import (expose_trainer,
+                                                   scrape_cluster)
+    reliability_metrics.reset()
+    reg = ServiceRegistry().start()
+    server, query = _mini_serving()
+    trainer_srv = None
+    try:
+        host, port = server._httpd.server_address[:2]
+        report_server_to_registry(reg.address, "scrape_srv", host, port)
+        trainer_srv = expose_trainer(registry_address=reg.address,
+                                     name="scrape_trn",
+                                     goodput_floor=None)
+        # trainer-side signals on the process registry
+        reliability_metrics.set_gauge(tnames.TRAIN_GOODPUT, 0.97)
+        for ms in (5.0, 7.0, 9.0):
+            reliability_metrics.observe_ms(tnames.TRAIN_STEP_WALL, ms)
+        # serving-side traffic
+        for i in range(4):
+            req = urllib.request.Request(
+                server.address, data=json.dumps({"x": i}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=15).read()
+
+        # registry kinds are explicit, defaults preserved
+        infos = json.loads(urllib.request.urlopen(
+            reg.address + "/services", timeout=15).read())
+        kinds = {d["name"]: d.get("kind") for d in infos}
+        assert kinds == {"scrape_srv": "serving", "scrape_trn": "trainer"}
+
+        snap = scrape_cluster(reg.address)
+        assert snap.merged["telemetry.scrape.workers"] == 2
+        # both endpoints expose THIS process's registry: hists bucket-sum
+        # (2x), gauges keep max (same value twice -> itself)
+        assert snap.merged["train.step.wall.count"] == 6
+        assert snap.merged[tnames.TRAIN_GOODPUT] == pytest.approx(0.97)
+        assert snap.merged[tnames.SERVING_REQUEST_TOTAL] == 8
+        assert snap.merged["serving.request.e2e.count"] == 8
+
+        trn = scrape_cluster(reg.address, kind="trainer")
+        assert trn.merged["telemetry.scrape.workers"] == 1
+        assert trn.workers[0][0].name == "scrape_trn"
+        srv = scrape_cluster(reg.address, kind="serving")
+        assert srv.merged["telemetry.scrape.workers"] == 1
+        assert srv.merged[tnames.SERVING_REQUEST_TOTAL] == 4
+    finally:
+        if trainer_srv is not None:
+            trainer_srv.stop()
+        query.stop()
+        server.stop()
+        reg.stop()
+        reliability_metrics.reset()
+
+
+def test_register_wire_format_default_omits_kind():
+    """Satellite contract: a plain serving register posts the pre-kind
+    body, and a registry accepts a kind-less body (old client)."""
+    from mmlspark_tpu.io import ServiceRegistry
+    reg = ServiceRegistry().start()
+    try:
+        body = {"name": "old", "host": "127.0.0.1", "port": 9,
+                "process_id": 0, "num_partitions": 1}
+        req = urllib.request.Request(
+            reg.address + "/register", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        assert urllib.request.urlopen(req, timeout=15).status == 200
+        assert reg.services("old")[0].kind == "serving"
+    finally:
+        reg.stop()
+
+
+def test_expose_trainer_appends_goodput_objective_once():
+    engine = tslo.get_engine()
+    before = list(engine.objectives)
+    from mmlspark_tpu.telemetry.exposition import expose_trainer
+    srv = expose_trainer(goodput_floor=0.8)
+    try:
+        names = [o.name for o in tslo.get_engine().objectives]
+        assert names.count("train.goodput.floor") == 1
+        # /slo and /metrics answer on the bare exposition server
+        verdict = json.loads(urllib.request.urlopen(
+            srv.address + "/slo", timeout=15).read())
+        assert any(o["objective"]["name"] == "train.goodput.floor"
+                   for o in verdict["objectives"])
+        text = urllib.request.urlopen(
+            srv.address + "/metrics", timeout=15).read().decode()
+        assert "# TYPE" in text
+        assert urllib.request.urlopen(
+            srv.address + "/metrics.json", timeout=15).status == 200
+        # idempotent: a second mount does not duplicate the objective
+        srv2 = expose_trainer(goodput_floor=0.8)
+        srv2.stop()
+        names = [o.name for o in tslo.get_engine().objectives]
+        assert names.count("train.goodput.floor") == 1
+    finally:
+        srv.stop()
+        engine.objectives[:] = before
+
+
+# ------------------------------------------------- run_stream integration
+def test_lm_run_stream_drives_step_clock(tmp_path):
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    from mmlspark_tpu.models.dnn.lm_training import ShardedLMTrainer
+    reliability_metrics.reset(prefix="train.")
+    t = ShardedLMTrainer(vocab_size=64, d_model=32, n_heads=4,
+                         n_layers=1, d_ff=64, max_len=16, seed=0)
+    rng = np.random.default_rng(0)
+    dp = t.mesh.shape["data"]
+    batches = [rng.integers(0, 64, size=(dp, 12)).astype(np.int32)
+               for _ in range(5)]
+    losses = t.run_stream(batches, checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_every=2, resume=False,
+                          handle_signals=False)
+    assert len(losses) == 5
+    clock = get_clock()
+    assert clock is not None
+    snap = clock.snapshot()
+    assert snap["steps"] == 5
+    # the loss fetch is the block boundary: device time surfaced
+    assert snap["phases"]["device_s"] > 0.0
+    assert snap["phases"]["lost_s"] == 0.0
+    assert reliability_metrics.peek_histogram(
+        tnames.TRAIN_STEP_WALL).count == 5
+    assert 0.0 < reliability_metrics.gauge(tnames.TRAIN_GOODPUT) <= 1.0
+
+
+def test_fit_booster_step_clock_reports_phases():
+    from mmlspark_tpu.models.gbdt.boosting import BoostParams, fit_booster
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    clock = StepClock(registry=MetricsRegistry(), install=False)
+    fit_booster(x, y, BoostParams(num_iterations=4, max_depth=3,
+                                  min_data_in_leaf=5),
+                step_clock=clock)
+    snap = clock.snapshot()
+    assert snap["steps"] >= 1            # fused path: chunks are steps
+    assert snap["wall_s"] > 0.0
+    assert snap["phases"]["device_s"] > 0.0   # the packed fetch
+    assert snap["goodput"] > 0.0
+
+
+# ------------------------------------------------- benchdiff MULTICHIP
+def _multichip_wrapper(tmp_path, name, bytes_dp, bubble_m8,
+                       s_per_step_m8=1.0):
+    sweep = {"8": {"s_per_step": s_per_step_m8, "us_per_token": 1.0,
+                   "ticks": 9, "bubble_fraction": bubble_m8}}
+    traffic = {"gbdt_data_parallel":
+               {"all-reduce": {"ops": 4, "bytes": bytes_dp}}}
+    tail = ("GPIPE_MSWEEP " + json.dumps({"shape": "pp=2", "sweep": sweep})
+            + "\nTRAFFIC " + json.dumps(traffic) + "\n")
+    path = tmp_path / name
+    path.write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True, "tail": tail}))
+    return str(path)
+
+
+def test_benchdiff_multichip_wrapper_gates_regressions(tmp_path, capsys):
+    from mmlspark_tpu.telemetry.benchdiff import main
+    r1 = _multichip_wrapper(tmp_path, "MULTICHIP_r01.json",
+                            bytes_dp=1000, bubble_m8=0.111)
+    r2 = _multichip_wrapper(tmp_path, "MULTICHIP_r02.json",
+                            bytes_dp=1000, bubble_m8=0.111)
+    assert main(["--threshold", "0.1", r1, r2]) == 0
+    out = capsys.readouterr().out
+    assert "comm.gbdt_data_parallel.all-reduce.bytes" in out
+    assert "gpipe_m8_bubble_fraction" in out
+
+    # collective bytes GROWING is a regression (lower-better by birth)
+    r3 = _multichip_wrapper(tmp_path, "MULTICHIP_r03.json",
+                            bytes_dp=2000, bubble_m8=0.111)
+    assert main(["--threshold", "0.1", r1, r3]) == 1
+    capsys.readouterr()
+    # bubble fraction growing gates too
+    r4 = _multichip_wrapper(tmp_path, "MULTICHIP_r04.json",
+                            bytes_dp=1000, bubble_m8=0.5)
+    assert main(["--threshold", "0.1", r1, r4]) == 1
+    capsys.readouterr()
+    # shrinking traffic is an improvement, not a regression
+    r5 = _multichip_wrapper(tmp_path, "MULTICHIP_r05.json",
+                            bytes_dp=500, bubble_m8=0.05)
+    assert main(["--threshold", "0.1", r1, r5]) == 0
+    capsys.readouterr()
+
+
+def test_benchdiff_multichip_natural_round_order(tmp_path, capsys):
+    from mmlspark_tpu.telemetry.benchdiff import main
+    paths = [_multichip_wrapper(tmp_path, f"MULTICHIP_r{n:02d}.json",
+                                bytes_dp=b, bubble_m8=0.1)
+             for n, b in ((1, 3000), (2, 2000), (10, 1000))]
+    # natural order puts r10 LAST: trajectory is improving, exit 0
+    assert main(["--threshold", "0.1", paths[2], paths[0],
+                 paths[1]]) == 0
+    out = capsys.readouterr().out
+    assert out.index("r01.json:3000") < out.index("r10.json:1000")

@@ -138,6 +138,12 @@ def test_plan_recompiles_pinned_zero_on_repeated_same_bucket(perf_state):
     cache hits — ONE plan.compile, zero plan.recompiles. A second bucket
     costs one more compile, still zero recompiles."""
     model = _fit_gbdt(num_iterations=5)
+    # on a multi-device host the FIT itself compiles through the
+    # distributed AotCache and is recorded too (ISSUE 9: collective
+    # accounting rides every fit); this test pins the SERVING plan path,
+    # so the count starts after the fit
+    reliability_metrics.reset(prefix="plan.")
+    perf.get_compile_log().clear()
     transform = compile_serving_transform(model, ["features"])
     body = json.dumps({"features": [0.1] * 8}).encode()
     for _ in range(4):
