@@ -37,6 +37,11 @@ import numpy as np
 
 BASELINE_ROWS_ITERS_PER_SEC = 2.0e7  # A100-class LightGBM estimate (see docstring)
 
+# set (once, process-wide) when a headline fit failed to compile and the
+# B<128 joint routes were retired via MMLSPARK_TPU_HIST_JOINT64=0 — every
+# shape measured after the trip carries the annotation in its record
+_JOINT64_FALLBACK = None
+
 # 8M rows: large enough that steady-state device throughput dominates the
 # fixed per-fit dispatch/fetch latency (which is tunnel-inflated on the dev
 # link and absent in production); fits v5e HBM with wide margin
@@ -74,6 +79,155 @@ def measure_copy_bandwidth_gbps() -> float:
     return 32 * 2 * a.nbytes / max(d_big - d_small, 1e-6) / 1e9
 
 
+def _hist_route_table(n_bins: int, depth: int, has_planes: bool = False):
+    """Chosen kernel route per training level (ops.histogram_pallas's
+    routing table evaluated at the shapes this fit actually runs): level 0
+    is a full m=1 pass; sibling subtraction makes every later level a
+    left-children-only pass with m = 2^(d-1)."""
+    from mmlspark_tpu.ops.histogram_pallas import kernel_route
+    table = {}
+    for d in range(depth):
+        m = 1 if d == 0 else 2 ** (d - 1)
+        kind, lo = kernel_route(m, n_bins, has_planes=has_planes)
+        table[f"level{d}_m{m}"] = f"{kind}:lo{lo}"
+    return table
+
+
+def _phase_breakdown(d_bins, d_y, params, iters: int = 2):
+    """Per-phase device time of one boosting iteration, via in-graph
+    chained-prefix programs: four jitted programs run (objective),
+    (objective+histograms), (+split search), (+row routing) over the SAME
+    staged bins with in-graph `lax.scan` repetition and ONE value fetch
+    each; consecutive differences are the phase costs. Histogram/split
+    cost is data-independent (one-hot compares run regardless of node
+    assignment), so the prefix subtraction stays valid even though only
+    the full program routes rows. The routing phase runs the SHIPPED
+    `trainer.route_rows_level` — the measured line is the shipped code."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.gbdt import objectives as obj_mod
+    from mmlspark_tpu.models.gbdt import trainer as tr
+    from mmlspark_tpu.ops.histogram import node_feature_histograms
+
+    n, F = d_bins.shape
+    B = params.max_bin + 1
+    depth = params.max_depth
+    cfg = tr.TreeConfig(n_features=F, n_bins=B, max_depth=depth,
+                        num_leaves=params.num_leaves,
+                        min_data_in_leaf=params.min_data_in_leaf)
+    fmask = jnp.ones(F, bool)
+    row = jnp.arange(n, dtype=jnp.int32)
+
+    def interleave(left, sub):
+        return jnp.stack([left, sub], axis=1).reshape(
+            left.shape[0] * 2, *left.shape[1:])
+
+    def make(stage):
+        @jax.jit
+        def run(margin):
+            def body(carry, i):
+                marg = margin * (1.0 + i * 1e-6)
+                g, h = obj_mod.binary_grad_hess(marg, d_y, 1.0)
+                acc = carry + g.sum() + h.sum()
+                if stage == "objective":
+                    return acc, None
+                bins_t = d_bins.T
+                node_of_row = jnp.zeros(n, jnp.int32)
+                for d in range(depth):
+                    level_base = 2 ** d - 1
+                    m = 2 ** d
+                    node_local = node_of_row - level_base
+                    active = (node_local >= 0) & (node_local < m)
+                    if d == 0:
+                        hg, hh, hc = node_feature_histograms(
+                            d_bins, g, h, node_local, active, 1, B)
+                    else:
+                        # mirror sibling subtraction: left children only,
+                        # synthetic node ids when routing isn't in the
+                        # prefix (kernel cost is node-independent)
+                        if stage == "route":
+                            nl, act = node_local // 2, \
+                                active & (node_local % 2 == 0)
+                        else:
+                            nl = jax.lax.rem(row, m // 2)
+                            act = jnp.ones(n, bool)
+                        lg, lh, lc = node_feature_histograms(
+                            d_bins, g, h, nl, act, m // 2, B)
+                        hg = interleave(lg, lg)
+                        hh = interleave(lh, lh)
+                        hc = interleave(lc, lc)
+                    acc = acc + hg.sum() + hh.sum() + hc.sum()
+                    if stage == "hist":
+                        continue
+                    pg, ph, pc = (hg[:, 0].sum(-1), hh[:, 0].sum(-1),
+                                  hc[:, 0].sum(-1))
+                    gain, feat, thr, is_cat, words = \
+                        tr._best_splits_for_level(hg, hh, hc, fmask, cfg,
+                                                  pg, ph, pc)
+                    acc = acc + jnp.where(jnp.isfinite(gain), gain,
+                                          0.0).sum() + feat.sum()
+                    if stage == "split":
+                        continue
+                    node_of_row = tr.route_rows_level(
+                        bins_t, node_of_row, node_local, feat, thr,
+                        jnp.isfinite(gain), level_base, m)
+                if stage == "route":
+                    acc = acc + node_of_row.sum()
+                return acc, None
+            out, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(iters))
+            return out
+        return run
+
+    margin = jnp.zeros(n, jnp.float32)
+    chain = {}
+    for stage in ("objective", "hist", "split", "route"):
+        fn = make(stage)
+        float(fn(margin))                     # compile + warm
+        t0 = time.time()
+        float(fn(margin))
+        chain[stage] = (time.time() - t0) / iters * 1000.0
+    out = {"objective_ms_per_iter": round(chain["objective"], 3)}
+    for name, hi, lo in (("histogram_ms_per_iter", "hist", "objective"),
+                         ("split_ms_per_iter", "split", "hist"),
+                         ("routing_ms_per_iter", "route", "split")):
+        out[name] = round(max(chain[hi] - chain[lo], 0.0), 3)
+    out["chain_ms_per_iter"] = {k: round(v, 3) for k, v in chain.items()}
+    return out
+
+
+def _planes_ab(staged, x, y, params, n_iters: int = 5):
+    """A/B of the level-invariant precomputed one-hot planes route vs the
+    default routed family, on the already-staged bins: two short fits per
+    arm (compile+warm, then timed). The plan build (once per fit) rides
+    inside the planes arm's time, as it does in production. Failures are
+    recorded, never raised — this is the measurement that decides whether
+    the planes route becomes the default next round."""
+    import dataclasses
+    from mmlspark_tpu.models.gbdt.boosting import fit_booster
+    p_ab = dataclasses.replace(params, num_iterations=n_iters)
+    out = {"iters": n_iters}
+    prev = os.environ.get("MMLSPARK_TPU_HIST")
+    try:
+        for tag, env in (("routed", "auto"), ("planes", "planes")):
+            os.environ["MMLSPARK_TPU_HIST"] = env
+            try:
+                fit_booster(x, y, p_ab, prebinned=staged)
+                t0 = time.time()
+                fit_booster(x, y, p_ab, prebinned=staged)
+                out[f"{tag}_s"] = round(time.time() - t0, 4)
+            except Exception as e:  # noqa: BLE001 — record, don't kill bench
+                out[f"{tag}_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if prev is None:
+            os.environ.pop("MMLSPARK_TPU_HIST", None)
+        else:
+            os.environ["MMLSPARK_TPU_HIST"] = prev
+    if "routed_s" in out and "planes_s" in out:
+        out["planes_speedup"] = round(out["routed_s"]
+                                      / max(out["planes_s"], 1e-9), 3)
+    return out
+
+
 def _hist_traffic_bytes(n_rows: int, n_feat: int, depth: int,
                         n_iters: int) -> float:
     """Lower bound on histogram-pass HBM traffic: every level re-reads the
@@ -102,6 +256,8 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
     # so the timed region is the training loop itself (BENCH_MODE=gbdt_e2e
     # measures the full ingest->train path with the copies included)
     import jax
+    from mmlspark_tpu.reliability.metrics import reliability_metrics
+    reliability_metrics.reset("gbdt.hist.")   # per-shape route counters
     mapper = binning.fit_bins(x, max_bin=params.max_bin, seed=0)
     d_bins = binning.apply_bins_device(mapper, x)
     d_y = jax.device_put(y)
@@ -111,8 +267,21 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
     # (cached to .jax_cache for later rounds); the timed run is steady-state.
     # warmup-minus-steady is the compile+trace cost estimate the compile
     # telemetry rides into the output (zero-ish on cache-hot rounds).
+    global _JOINT64_FALLBACK
     t0 = time.time()
-    fit_booster(x, y, params, prebinned=staged)
+    try:
+        fit_booster(x, y, params, prebinned=staged)
+    except Exception as e:  # noqa: BLE001
+        # the round-6 B=64 joint routes use narrow-lane (16/32) Mosaic
+        # layouts unproven on this TPU generation: fall back to the
+        # measured direct route rather than losing the bench record, and
+        # say so in the output. The flag is process-wide (retrying a
+        # known-broken compile per shape would just fail again), so EVERY
+        # later shape's record carries the annotation too.
+        _JOINT64_FALLBACK = f"{type(e).__name__}: {e}"
+        os.environ["MMLSPARK_TPU_HIST_JOINT64"] = "0"
+        fit_booster(x, y, params, prebinned=staged)
+    joint64_fallback = _JOINT64_FALLBACK
     warmup_s = time.time() - t0
     # goodput/MFU accounting on the TIMED fit (telemetry/goodput.py):
     # the fused loop drives the clock per chunk and books the packed
@@ -141,6 +310,35 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
         "hist_bytes_per_sec": round(traffic / elapsed, 1),
         "bound": "vpu-onehot (see ops/histogram_pallas.py)",
     }
+    if joint64_fallback:
+        out["joint64_fallback"] = joint64_fallback
+    # has_planes mirrors what THIS fit did (fit_booster builds the plan
+    # when the env asks for it), so the claimed table matches the
+    # routes-taken counters below on a planes run
+    out["hist_routes"] = _hist_route_table(
+        params.max_bin + 1, params.max_depth,
+        has_planes=os.environ.get("MMLSPARK_TPU_HIST") == "planes")
+    # routes ACTUALLY instantiated (trace-time gbdt.hist.route.* counters)
+    # vs the table above — on a CPU run these say "xla" while the table
+    # says what the TPU kernel family would pick
+    out["hist_routes_taken"] = {
+        k.rsplit(".", 1)[-1]: v
+        for k, v in reliability_metrics.snapshot().items()
+        if k.startswith("gbdt.hist.route.")}
+    # per-phase breakdown (round 6): "bound" claims trace to a measured
+    # line instead of a docstring assertion
+    if os.environ.get("BENCH_PHASES", "1") != "0":
+        try:
+            phases = _phase_breakdown(d_bins, d_y, params)
+        except Exception as e:  # noqa: BLE001 — breakdown must not kill bench
+            phases = {"error": f"{type(e).__name__}: {e}"}
+        out["phases"] = phases
+        keyed = {k: v for k, v in phases.items()
+                 if k.endswith("_ms_per_iter") and isinstance(v, float)}
+        if keyed:
+            worst = max(keyed, key=keyed.get)
+            out["bound"] = (f"{worst.replace('_ms_per_iter', '')} "
+                            f"(measured per-phase, BENCH_EXTRA_r06.json)")
     # process-wide compile log (telemetry/perf.py): AOT compiles this
     # run recorded with cost analysis; recompiles must stay 0
     cstats = tperf.compile_stats()
@@ -159,7 +357,7 @@ def run_shape(n_rows: int, n_feat: int, max_bin: int, n_iters: int,
         out["measured_copy_gbps"] = round(copy_gbps, 1)
         out["hbm_utilization"] = round(
             tperf.hbm_utilization(traffic / elapsed, copy_gbps), 4)
-    return out, booster, x
+    return out, booster, x, y, staged
 
 
 def _bench_gbdt_e2e():
@@ -713,6 +911,78 @@ def _bench_ckpt():
         "write_errors": snap.get("checkpoint.write.errors", 0)}))
 
 
+def _bench_hist():
+    """Standalone per-(m, B, route) histogram-kernel grid (round 6): the
+    measurement that refreshes ops/histogram_pallas's routing table. Every
+    route the family can express runs at every (m, B) point — direct,
+    joint at each LO <= B, and the precomputed-plane route where LO | B —
+    with in-graph lax.scan repetition and one value fetch. A point that
+    fails to compile (e.g. a narrow-lane layout Mosaic rejects on some
+    TPU generation) records its error string instead of killing the mode.
+    Prints one JSON line; the grid dict is the artifact."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops import histogram_pallas as hp
+
+    n = int(os.environ.get("BENCH_HIST_ROWS", 1_000_000))
+    F = int(os.environ.get("BENCH_HIST_FEATURES", 32))
+    reps = int(os.environ.get("BENCH_HIST_REPS", 10))
+    rng = np.random.default_rng(0)
+    grid = {}
+    for B in (64, 256):
+        bins = jnp.asarray(rng.integers(0, B, size=(n, F)).astype(np.uint8))
+        grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        hess = jnp.asarray(rng.uniform(0.1, 1, size=n).astype(np.float32))
+        base = jnp.asarray(rng.integers(0, 1 << 20, size=n).astype(np.int32))
+        plane_lo = hp.plan_lo_bins(B)
+        planes = hp.build_hist_plan(bins, B) if plane_lo else None
+        for m in (1, 2, 4, 8, 16):
+            routes = [("direct", B)]
+            routes += [("joint", lo) for lo in (16, 32, 64, 128) if lo < B]
+            if planes is not None:
+                routes.append(("planes", plane_lo))
+
+            for route in routes:
+                kind, lo = route
+                use_planes = kind == "planes"
+
+                def make(route=route, m=m, B=B, bins=bins,
+                         use_planes=use_planes):
+                    @jax.jit
+                    def run():
+                        def body(c, i):
+                            nd = jax.lax.rem(base + i, m)
+                            hg, hh, hc = hp.pallas_hist(
+                                bins, grad, hess, nd, nd >= 0, m, B,
+                                route=route,
+                                lo_planes=planes if use_planes else None,
+                                plane_lo=plane_lo if use_planes else 0)
+                            return c + hg.sum() + hh.sum() + hc.sum(), None
+                        s, _ = jax.lax.scan(body, jnp.float32(0),
+                                            jnp.arange(reps))
+                        return s
+                    return run
+
+                key = f"B{B}_m{m}_{kind}_lo{lo}"
+                try:
+                    fn = make()
+                    float(fn())              # compile + warm
+                    t0 = time.time()
+                    float(fn())
+                    grid[key] = round((time.time() - t0) / reps * 1000, 3)
+                except Exception as e:  # noqa: BLE001
+                    grid[key] = f"{type(e).__name__}: {e}"[:200]
+    headline = grid.get("B64_m1_direct_lo64")
+    print(json.dumps({
+        "metric": "hist_kernel_grid_ms", "unit": "ms/call",
+        "value": headline if isinstance(headline, float) else 0.0,
+        "vs_baseline": 0.0, "rows": n, "features": F, "reps": reps,
+        # ms/call regresses by GROWING: benchdiff gates this record
+        # lower-is-better without a CLI flag (like MULTICHIP synthesis)
+        "lower_better": True,
+        "grid": grid}))
+
+
 V5E_BF16_PEAK_TFLOPS = 197.0  # chip spec; fraction-of-peak anchor
 
 
@@ -1032,22 +1302,76 @@ def main():
         return _bench_ckpt()
     if mode == "telemetry":
         return _bench_telemetry()
+    if mode == "hist":
+        return _bench_hist()
     # predict/shap modes never print the bandwidth fields — don't spend the
     # ~40 timed 1 GiB copy passes measuring one
     copy_gbps = (0.0 if mode in ("predict", "shap")
                  else measure_copy_bandwidth_gbps())
+    wide_rows = []
     if os.environ.get("BENCH_SHAPES") == "wide":
         # verdict round-2 item 1: more shapes so the headline isn't a
         # single-point claim. Printed BEFORE the canonical line (the driver
         # parses the last line only).
         for nr, nf, mb, it in ((1_000_000, 32, 63, N_ITERS),
                                (1_000_000, 128, 254, 10)):
-            res, _, _ = run_shape(nr, nf, mb, it, copy_gbps,
-                                  "gbdt_train_rows_iters_per_sec")
+            res, _, _, _, _ = run_shape(nr, nf, mb, it, copy_gbps,
+                                        "gbdt_train_rows_iters_per_sec")
+            wide_rows.append(res)
             print(json.dumps(res))
 
-    res, booster, x = run_shape(N_ROWS, N_FEATURES, 63, N_ITERS, copy_gbps,
-                                "gbdt_train_rows_iters_per_sec")
+    res, booster, x, y, staged = run_shape(N_ROWS, N_FEATURES, 63, N_ITERS,
+                                           copy_gbps,
+                                           "gbdt_train_rows_iters_per_sec")
+
+    # BENCH_EXTRA_r06.json (round 6): the per-phase breakdown, the kernel
+    # route table, and the planes-vs-routed A/B, auto-emitted so every
+    # "bound" claim traces to a measured line in a committed artifact
+    try:
+        extra = {
+            "comment": (
+                "Auto-emitted by bench.py (round 6). Headline carries the "
+                "in-graph chained-prefix per-phase breakdown (objective / "
+                "histogram kernel / split search / row routing, "
+                "ms per iteration) and the kernel route chosen per level "
+                "(ops/histogram_pallas.kernel_route). planes_ab is the "
+                "level-invariant precomputed one-hot plane route "
+                "(MMLSPARK_TPU_HIST=planes) A/B that decides next round's "
+                "default. Reproduce: python bench.py; BENCH_SHAPES=wide "
+                "adds the wide rows; BENCH_MODE=hist prints the "
+                "per-(m, B, LO) kernel grid."),
+            "backend": jax.default_backend(),
+            "gbdt_train_headline_8m_32f": res,
+        }
+        if wide_rows:
+            extra["wide_shapes"] = wide_rows
+        depth = int(os.environ.get("BENCH_DEPTH", 5))
+        extra["hist_route_table"] = {
+            "64bins": _hist_route_table(64, depth),
+            "64bins_planes": _hist_route_table(64, depth, has_planes=True),
+            "255bins": _hist_route_table(255, depth),
+        }
+        if (os.environ.get("BENCH_MODE") not in ("predict", "shap")
+                and (jax.default_backend() == "tpu"
+                     or os.environ.get("BENCH_PLANES_AB") == "1")
+                and os.environ.get("BENCH_PLANES_AB") != "0"):
+            from mmlspark_tpu.models.gbdt.boosting import BoostParams
+            p_ab = BoostParams(objective="binary", num_iterations=5,
+                               num_leaves=31,
+                               max_depth=depth, max_bin=63,
+                               min_data_in_leaf=20)
+            extra["planes_ab"] = _planes_ab(staged, x, y, p_ab)
+            res["planes_ab"] = extra["planes_ab"]
+        extra_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_EXTRA_r06.json")
+        with open(extra_path, "w") as f:
+            json.dump(extra, f, indent=1)
+    except Exception as e:  # noqa: BLE001 — artifact write must not kill bench
+        print(json.dumps({"metric": "bench_extra_r06_error",
+                          "value": 0.0, "unit": "",
+                          "vs_baseline": 0.0,
+                          "error": f"{type(e).__name__}: {e}"}))
 
     if os.environ.get("BENCH_MODE") == "shap":
         # exact path-dependent TreeSHAP on device (shap_device.py): the

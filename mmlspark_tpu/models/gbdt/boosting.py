@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import Callable, Optional
 
@@ -221,11 +222,11 @@ def _device_metric(name, objective, margin, y, num_class):
 @functools.partial(
     jax.jit,
     static_argnames=("p", "cfg", "chunk_len", "k_out", "axis_name",
-                     "has_valid", "voting_top_k"))
+                     "has_valid", "voting_top_k", "plane_lo"))
 def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
                  v_margin, key, it_base, p: BoostParams, cfg, chunk_len: int,
                  k_out: int, axis_name=None, has_valid: bool = False,
-                 voting_top_k=None):
+                 voting_top_k=None, lo_planes=None, plane_lo: int = 0):
     """One fused chunk of boosting iterations: a lax.scan with NO host
     round-trips — the design that actually fits the TPU (the reference's
     per-iteration JNI hot loop, TrainUtils.scala:360-427, becomes one XLA
@@ -264,7 +265,9 @@ def _boost_chunk(d_bins, y_j, w_j, pres_j, margin, init_margin, v_bins, vy,
             tree, delta = trainer.train_one_tree(d_bins, gk, hk, fmask, cfg,
                                                  axis_name=axis_name,
                                                  voting_top_k=voting_top_k,
-                                                 count_w=count_w)
+                                                 count_w=count_w,
+                                                 lo_planes=lo_planes,
+                                                 plane_lo=plane_lo)
             sfs.append(tree.split_feature)
             sbs.append(tree.split_bin)
             lvs.append(tree.leaf_value)
@@ -484,9 +487,15 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
     k_out = p.num_class if multiclass else 1
     put = put_fn or jnp.asarray
     custom_tree_fn = tree_fn is not None
+    # level-invariant one-hot planes (round 6, MMLSPARK_TPU_HIST=planes):
+    # built ONCE per fit below (bins never change across levels/trees/
+    # iterations); the default tree_fn closes over the locals LATE so the
+    # plan staged after binning is what the host loop uses too
+    _hist_planes, _hist_plane_lo = None, 0
     if tree_fn is None:
         tree_fn = lambda b, g, h, fm, cfg, cw=None: trainer.train_one_tree(
-            b, g, h, fm, cfg, count_w=cw)
+            b, g, h, fm, cfg, count_w=cw, lo_planes=_hist_planes,
+            plane_lo=_hist_plane_lo)
 
     staged_y = None
     if prebinned is not None:
@@ -517,6 +526,18 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
                 d_bins = put(parallel_apply_bins(mapper, x, ingest))
         else:
             d_bins = put(binning.apply_bins_device(mapper, x))
+    if (os.environ.get("MMLSPARK_TPU_HIST") == "planes"
+            and not custom_tree_fn and chunk_fn is None and put_fn is None):
+        # precompute the level-invariant lo one-hot planes once per fit;
+        # they ride the fused scan as a hoisted constant (F*LO*n int8
+        # bytes resident in HBM — see histogram_pallas's routing notes)
+        from ...ops import histogram_pallas as _hp
+        _lo = _hp.plan_lo_bins(p.max_bin + 1)
+        if _lo:
+            _hist_planes = _hp.build_hist_plan(d_bins, p.max_bin + 1)
+            _hist_plane_lo = _lo
+            reliability_metrics.set_gauge(tnames.GBDT_HIST_PLAN_BYTES,
+                                          float(_hist_planes.nbytes))
     y_j = (put(staged_y.astype(jnp.float32)) if staged_y is not None
            else put(np.asarray(y, dtype=np.float32)))
     w_j = None if weights is None else put(np.asarray(weights, dtype=np.float32))
@@ -695,12 +716,18 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
             _chunk_t0 = time.perf_counter()
             clen = min(chunk, p.num_iterations - it)
             key, kc = jax.random.split(key)
+            # planes ride as explicit kwargs ONLY when built: a custom
+            # chunk_fn (distributed) predates them and is never paired
+            # with a plan (the build above is gated on chunk_fn is None)
+            _plane_kw = ({"lo_planes": _hist_planes,
+                          "plane_lo": _hist_plane_lo}
+                         if _hist_planes is not None else {})
             with _clk_step(it):
                 (margin, v_margin_, sf_c, sb_c, lv_c, gn_c, cv_c, ic_c,
                  cw_c, mts) = fused(
                     d_bins, y_j, w_j, pres_j, margin, margin_init, v_bins_,
                     vy_j, v_margin_, kc, it + iter_offset, p, cfg, clen,
-                    k_out, has_valid=has_valid)
+                    k_out, has_valid=has_valid, **_plane_kw)
                 parts.append((sf_c, sb_c, lv_c, gn_c, cv_c, ic_c, cw_c))
                 if checkpoint_fn is not None:
                     # chunk boundary = natural checkpoint step: build the

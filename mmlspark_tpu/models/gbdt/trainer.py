@@ -270,12 +270,43 @@ def _voting_feature_mask(hg, hh, hc, feature_mask, cfg: TreeConfig,
     return vidx, has_vote
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "axis_name", "voting_top_k"))
+def route_rows_level(bins_t, node_of_row, node_local, feat, thr, apply,
+                     level_base: int, m: int, is_cat=None, words=None):
+    """Advance rows whose node split, for one level with m <= 64 nodes.
+
+    ONE row-gather pulls the m winning features' bin stripes (m x n uint8)
+    — round 6's Amdahl cleanup: the former per-node `dynamic_index_in_dim`
+    loop issued up to 63 separate dynamic slices of `bins_t` per tree,
+    each its own fusion; the gather plus the select chain below is a
+    single fused elementwise pass per level. No n x F or n x m f32
+    materialization at all. Shared with bench.py's per-phase breakdown so
+    the measured routing cost is the shipped routing code."""
+    w16 = 0 if words is None else words.shape[-1]
+    bins_sel = jnp.take(bins_t, feat, axis=0, mode="clip").astype(
+        jnp.int32)                                           # (m, n) stripes
+    go_left = bins_sel <= thr[:, None]                       # (m, n)
+    if w16:
+        # category membership via the shared gather-free bit-test
+        # (pure fused VPU ops, no table gather over n)
+        member = packed_member(bins_sel, words[:, None, :])
+        go_left = jnp.where(is_cat[:, None], member, go_left)
+    for j in range(m):  # unrolled: XLA fuses the level into one pass
+        heap_j = level_base + j
+        child_j = jnp.where(go_left[j], 2 * heap_j + 1, 2 * heap_j + 2)
+        upd = (node_local == j) & apply[j]
+        node_of_row = jnp.where(upd, child_j, node_of_row)
+    return node_of_row
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "axis_name",
+                                             "voting_top_k", "plane_lo"))
 def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                    feature_mask: jnp.ndarray, cfg: TreeConfig,
                    axis_name: Optional[str] = None,
                    voting_top_k: Optional[int] = None,
-                   count_w: Optional[jnp.ndarray] = None):
+                   count_w: Optional[jnp.ndarray] = None,
+                   lo_planes: Optional[jnp.ndarray] = None,
+                   plane_lo: int = 0):
     """Grow one tree. grad/hess must already fold in sample weights and
     bagging masks (zeros drop a row). `count_w` is the presence indicator for
     min_data_in_leaf counting (1 = row participates this iteration; 0 =
@@ -283,6 +314,11 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     exact 0 under f32 sigmoid saturation or custom objectives.
     Returns (Tree, new_margin_delta) where delta = leaf_value[resting node]
     per row.
+
+    `lo_planes`/`plane_lo`: per-fit level-invariant one-hot planes
+    (ops.histogram_pallas.build_hist_plan) — level-invariant by
+    construction, so the fused boosting scan hoists them and every level
+    of every tree reuses ONE resident copy.
 
     Under shard_map, `axis_name` turns on psum of histograms + node stats:
     the one collective per level that makes training data-parallel.
@@ -325,7 +361,7 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # incompatible with sibling subtraction)
             hg, hh, hc = node_feature_histograms(
                 bins, grad, hess, node_local, active, m, cfg.n_bins,
-                count_w=count_w)
+                count_w=count_w, lo_planes=lo_planes, plane_lo=plane_lo)
             if voting:
                 parent_g = psum(hg[:, 0].sum(-1))
                 parent_h = psum(hh[:, 0].sum(-1))
@@ -356,7 +392,8 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             left_active = active & (node_local % 2 == 0)
             lg, lh, lc = node_feature_histograms(
                 bins, grad, hess, node_local // 2, left_active, m // 2,
-                cfg.n_bins, count_w=count_w)
+                cfg.n_bins, count_w=count_w, lo_planes=lo_planes,
+                plane_lo=plane_lo)
             lg, lh, lc = psum(lg), psum(lh), psum(lc)
             hg = _interleave(lg, prev_hists[0] - lg)
             hh = _interleave(lh, prev_hists[1] - lh)
@@ -399,27 +436,17 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cover_arr = cover_arr.at[heap_ids].set(
             jnp.where(child_valid, parent_c, 0.0).astype(jnp.float32))
 
-        # advance rows whose node split. Two gather-free strategies (TPU
-        # row-gathers over n are serial):
+        # advance rows whose node split. Two gather-free-per-row
+        # strategies (TPU per-row gathers over n are serial):
         if m <= 64:
-            # per-node row stripes: each split node costs one dynamic-slice
-            # of transposed bins (n bytes) + a fused select chain — no n x F
-            # or n x m materialization at all. Unrolled so XLA fuses the
-            # whole level into one elementwise pass.
-            for j in range(m):
-                bj = jax.lax.dynamic_index_in_dim(bins_t, feat[j], 0,
-                                                  keepdims=False)  # (n,) u8
-                heap_j = level_base + j
-                bj32 = bj.astype(jnp.int32)
-                go_left = bj32 <= thr[j]
-                if w16:
-                    # category membership via the shared gather-free
-                    # bit-test (pure fused VPU ops, no table gather over n)
-                    member = packed_member(bj32, words[j])
-                    go_left = jnp.where(is_cat[j], member, go_left)
-                child_j = jnp.where(go_left, 2 * heap_j + 1, 2 * heap_j + 2)
-                upd = (node_local == j) & apply[j]
-                node_of_row = jnp.where(upd, child_j, node_of_row)
+            # one (m, n) stripe gather + a fused select chain per level
+            # (route_rows_level — the round-6 Amdahl cleanup of the former
+            # 63-dynamic-slices-per-tree loop)
+            node_of_row = route_rows_level(
+                bins_t, node_of_row, node_local, feat, thr, apply,
+                level_base, m,
+                is_cat=is_cat if w16 else None,
+                words=words if w16 else None)
         else:
             # deep levels (m > 64): unrolling would blow up the program;
             # one-hot contractions cost O(n*(m+F)) but stay fully parallel.

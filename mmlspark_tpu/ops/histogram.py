@@ -5,9 +5,18 @@ kernels (the work inside `LGBM_BoosterUpdateOneIter`, reference:
 lightgbm/TrainUtils.scala:326-358 — SURVEY.md §2.9 item 1). Histogram build is
 memory-bandwidth-shaped (scatter-add over binned features), not matmul-shaped;
 the XLA path lowers to a single fused scatter-add via segment_sum over
-composite keys. A Pallas TPU kernel (`_pallas_hist`) keeps the bins tile in
-VMEM and accumulates all three statistics in one pass; selection is automatic
-by backend with an env escape hatch (MMLSPARK_TPU_HIST=xla|pallas).
+composite keys. The Pallas TPU kernel family (histogram_pallas.py) keeps the
+bins tile in VMEM and accumulates all three statistics in one pass; selection
+is automatic by backend with an env escape hatch:
+
+    MMLSPARK_TPU_HIST = auto | xla | pallas | planes
+
+`planes` additionally makes fit_booster precompute the level-invariant lo
+one-hot planes once per fit (build_hist_plan) and routes shallow levels
+through the plane-streaming kernel — see the routing table and ledger at the
+top of histogram_pallas.py. Every kernel-route selection is counted at trace
+time (`gbdt.hist.route.<route>`), so a compile log shows which kernels a fit
+actually instantiated.
 """
 from __future__ import annotations
 
@@ -15,6 +24,9 @@ import os
 
 import jax
 import jax.numpy as jnp
+
+from ..reliability.metrics import reliability_metrics
+from ..telemetry import names as tnames
 
 
 def _xla_hist(bins, grad, hess, node_local, active, n_nodes: int, n_bins: int,
@@ -50,26 +62,44 @@ def _xla_hist(bins, grad, hess, node_local, active, n_nodes: int, n_bins: int,
 
 
 def node_feature_histograms(bins, grad, hess, node_local, active,
-                            n_nodes: int, n_bins: int, count_w=None):
+                            n_nodes: int, n_bins: int, count_w=None,
+                            lo_planes=None, plane_lo: int = 0):
     """(n,F) uint8 bins + per-row grad/hess -> three (n_nodes, F, n_bins) f32
     histograms. Rows with active=False contribute nothing; rows with
-    count_w=0 contribute to no statistic's count (see _xla_hist)."""
+    count_w=0 contribute to no statistic's count (see _xla_hist).
+
+    `lo_planes`/`plane_lo`: per-fit precomputed level-invariant one-hot
+    planes (histogram_pallas.build_hist_plan) — routes shallow levels
+    through the plane-streaming kernel when present."""
     impl = os.environ.get("MMLSPARK_TPU_HIST", "auto")
-    use_pallas = (impl == "pallas"
+    use_pallas = (impl in ("pallas", "planes")
                   or (impl == "auto" and _should_use_pallas(n_nodes)))
     if use_pallas:
         try:
-            from .histogram_pallas import pallas_hist
+            from .histogram_pallas import kernel_route, pallas_hist
         except ImportError as e:
-            if impl == "pallas":
+            if impl in ("pallas", "planes"):
                 raise NotImplementedError(
-                    "MMLSPARK_TPU_HIST=pallas requested but the Pallas "
+                    f"MMLSPARK_TPU_HIST={impl} requested but the Pallas "
                     "histogram kernel failed to import; unset the env var to "
                     "use the XLA scatter path") from e
             use_pallas = False
     if use_pallas:
+        has_planes = lo_planes is not None and plane_lo > 0
+        kind, _lo = kernel_route(n_nodes, n_bins, has_planes=has_planes)
+        # trace-time routing record: one count per compiled (m, B) kernel
+        # instantiation — the compile-log view of which route a fit took
+        reliability_metrics.inc(tnames.gbdt_hist_route(kind))
         return pallas_hist(bins, grad, hess, node_local, active, n_nodes,
-                           n_bins, count_w=count_w)
+                           n_bins, count_w=count_w,
+                           lo_planes=lo_planes if has_planes else None,
+                           plane_lo=plane_lo if has_planes else 0,
+                           # interpreter escape hatch: exercises the REAL
+                           # routed-kernel plumbing on the CPU backend
+                           # (tier-1 end-to-end planes test; debugging)
+                           interpret=os.environ.get(
+                               "MMLSPARK_TPU_HIST_INTERPRET") == "1")
+    reliability_metrics.inc(tnames.gbdt_hist_route("xla"))
     return _xla_hist(bins, grad, hess, node_local, active, n_nodes, n_bins,
                      count_w=count_w)
 

@@ -18,6 +18,15 @@ JSONL (one ``{"metric": ...}`` object per line), or a single JSON
 object; rounds order by the wrapper's ``n`` when present, else by
 filename.
 
+GBDT regression gates (round 6): every ``gbdt_train_rows_iters_per_sec``
+record additionally synthesizes per-shape derived records
+``gbdt.<shape>.vs_baseline`` and ``gbdt.<shape>.hbm_utilization`` (both
+higher-is-better), so the headline's baseline ratio and the honesty
+metric gate across rounds exactly like the MULTICHIP bubble/traffic
+records — a kernel "win" that tanked either fails the diff:
+
+    python -m mmlspark_tpu.telemetry.benchdiff --threshold 0.1 BENCH_r*.json
+
 It also reads the ``MULTICHIP_r0N.json`` wrapper format (a driver
 object whose ``tail`` holds ``GPIPE_MSWEEP {json}`` / ``TRAFFIC
 {json}`` lines): the GPipe microbatch sweep becomes
@@ -99,6 +108,33 @@ def _tagged_records(tag: str, obj: dict) -> list:
     return []
 
 
+# extra numeric fields of the GBDT headline record that gate like
+# first-class metrics (higher is better for both: vs_baseline IS the
+# headline ratio, hbm_utilization is the honesty metric a fake win tanks)
+_GBDT_METRIC = "gbdt_train_rows_iters_per_sec"
+_GBDT_GATED_FIELDS = ("vs_baseline", "hbm_utilization")
+
+
+def _gbdt_records(rec: dict) -> list:
+    """Derived per-shape gate records from one GBDT headline record. The
+    shape rides in the metric name so the wide rows (same metric string,
+    earlier tail lines) gate independently of the canonical 8M headline
+    instead of being last-line-overwritten."""
+    if rec.get("metric") != _GBDT_METRIC:
+        return []
+    tag = str(rec.get("shape", "headline")).replace(" ", "_") or "headline"
+    out = []
+    for field in _GBDT_GATED_FIELDS:
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append({"metric": f"gbdt.{tag}.{field}", "value": float(v)})
+    return out
+
+
+def _with_derived(records: list) -> list:
+    return records + [d for r in records for d in _gbdt_records(r)]
+
+
 def _records_from_text(text: str) -> list:
     """Every JSON object with a "metric" key found in `text` (whole-file
     object, wrapper with parsed/tail, or JSONL), plus records synthesized
@@ -113,7 +149,7 @@ def _records_from_text(text: str) -> list:
         obj = None
     if isinstance(obj, dict):
         if "metric" in obj:
-            return [obj]
+            return _with_derived([obj])
         # driver wrapper: {"n": ..., "parsed": {...}, "tail": "..."} —
         # harvest every bench line from the tail (multi-mode runs print
         # several), with `parsed` as the authoritative headline. The
@@ -137,11 +173,15 @@ def _records_from_text(text: str) -> list:
                     continue
                 if isinstance(rec, dict) and "metric" in rec:
                     records.append(rec)
+        # derive BEFORE the parsed-headline dedup: the wide GBDT rows
+        # share the headline's metric string and would be dropped by it,
+        # but their per-shape derived gate records must survive
+        records = _with_derived(records)
         parsed = obj.get("parsed")
         if isinstance(parsed, dict) and "metric" in parsed:
             records = [r for r in records
                        if r.get("metric") != parsed["metric"]]
-            records.append(parsed)
+            records.extend(_with_derived([parsed]))
         return records
     # JSONL fallback
     for line in text.splitlines():
@@ -154,7 +194,7 @@ def _records_from_text(text: str) -> list:
             continue
         if isinstance(rec, dict) and "metric" in rec:
             records.append(rec)
-    return records
+    return _with_derived(records)
 
 
 def load_round(path: str) -> Tuple[object, dict]:

@@ -136,11 +136,16 @@ COUNTERS = {
                                  "the rate limit",
     "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
                              "(process/thread)",
+    "gbdt.hist.route.{route}": "histogram kernel-route selections "
+                               "(direct/joint/planes/xla), recorded at "
+                               "trace time — one per compiled (m, B) "
+                               "instantiation",
     "{breaker}.trips": "circuit-breaker trips, one counter per breaker "
                        "name",
 }
 
 # ----------------------------------------------------------------- gauges
+GBDT_HIST_PLAN_BYTES = "gbdt.hist.plan.bytes"
 SERVING_QUEUE_DEPTH = "serving.queue_depth"
 SERVING_BATCH_OCCUPANCY = "serving.batch.occupancy"
 CHECKPOINT_WRITE_PENDING = "checkpoint.write.pending"
@@ -155,6 +160,9 @@ TRAIN_LOST_SECONDS = "train.lost_seconds"
 TRAIN_STRAGGLERS = "train.stragglers"
 
 GAUGES = {
+    GBDT_HIST_PLAN_BYTES: "resident level-invariant one-hot plane bytes "
+                          "built for the current fit "
+                          "(MMLSPARK_TPU_HIST=planes)",
     SERVING_QUEUE_DEPTH: "partition queue depth at last enqueue",
     SERVING_BATCH_OCCUPANCY: "live-rows / max_batch of the last "
                              "dispatched batch",
@@ -341,3 +349,8 @@ def device_mem_peak(ordinal: int) -> str:
 def train_step_phase(phase: str) -> str:
     """train.step.{phase} — per-phase step-time histogram."""
     return f"train.step.{phase}"
+
+
+def gbdt_hist_route(route: str) -> str:
+    """gbdt.hist.route.{route} — per-route kernel-selection counter."""
+    return f"gbdt.hist.route.{route}"

@@ -2,11 +2,39 @@
 core/test/benchmarks/Benchmarks.scala:16-130: golden metric CSVs checked into
 tests/resources/benchmarks/, `add_benchmark(name, value, precision)` compares
 each run against the stored golden (creating it on first run).
+
+Also home of `measure_quiet` — the tier-1 deflake helper for wall-clock
+capability floors (the PR-9 quiet-host-retry pattern, see bench.py's
+serving A/B): a throughput/latency FLOOR proves a capability, so one
+quiet pass suffices; host contention can only push the measurement the
+failing way. Retry with a settle pause before letting a single noisy run
+fail the suite.
 """
 import csv
 import os
+import time
 
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "resources", "benchmarks")
+
+
+def measure_quiet(measure, ok, attempts: int = 3, settle_s: float = 1.5):
+    """Run a wall-clock-sensitive measurement up to `attempts` times and
+    return the first result satisfying `ok` (or the last attempt, so the
+    caller's assertion still fails — with the real numbers — on a build
+    that is genuinely too slow). Between attempts, sleep `settle_s` so a
+    transient load spike (a parallel suite, a review subagent) passes.
+
+    Use ONLY for capability floors ("sustains > N req/s", "p50 under X
+    ms"), never for regression *equality* checks: retrying those would
+    hide real drift."""
+    result = None
+    for attempt in range(attempts):
+        result = measure()
+        if ok(result):
+            return result
+        if attempt + 1 < attempts:
+            time.sleep(settle_s)
+    return result
 
 
 class Benchmarks:

@@ -12,6 +12,7 @@ syntax on /metrics and raw on /metrics.json; memory/compile metrics
 merge fleet-wide with the documented semantics (gauges max, counters
 sum); and the benchdiff CLI flags trajectory regressions."""
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -600,6 +601,62 @@ def test_benchdiff_reports_deltas_and_flags_regression(tmp_path, capsys):
     assert benchdiff.main(["--threshold", "0.5", "--lower-better",
                            "gbdt_e2e_fit_8m_32f"] + files) == 0
     capsys.readouterr()
+
+
+def test_benchdiff_gbdt_gates(tmp_path, capsys):
+    """Round-6 GBDT regression gates: the headline record's vs_baseline
+    and hbm_utilization synthesize per-shape derived records (higher is
+    better) that gate like MULTICHIP bubble/traffic — a throughput 'win'
+    that tanked the honesty metric fails the diff, and the wide shape's
+    record gates independently of the canonical 8M headline even though
+    both share one metric string."""
+    r1 = tmp_path / "BENCH_r01.json"
+    r2 = tmp_path / "BENCH_r02.json"
+
+    def rec(shape, vsb, hbm, value=100.0):
+        return {"metric": "gbdt_train_rows_iters_per_sec", "value": value,
+                "shape": shape, "vs_baseline": vsb, "hbm_utilization": hbm}
+
+    _write_round(r1, 1, [rec("1000000x128x255bins x10it", 0.9, 0.05),
+                         rec("8000000x32x64bins x20it", 4.4, 0.02)])
+    # headline value/ratio improves but hbm_utilization halves -> gated
+    _write_round(r2, 2, [rec("1000000x128x255bins x10it", 1.1, 0.05),
+                         rec("8000000x32x64bins x20it", 5.0, 0.01,
+                             value=120.0)])
+    files = [str(r1), str(r2)]
+    assert benchdiff.main(["--threshold", "0.15"] + files) == 1
+    err = capsys.readouterr().err
+    assert "gbdt.8000000x32x64bins_x20it.hbm_utilization" in err
+    assert "vs_baseline" not in err          # the ratio itself improved
+
+    # a vs_baseline drop on the WIDE shape alone is also caught
+    _write_round(r2, 2, [rec("1000000x128x255bins x10it", 0.5, 0.05),
+                         rec("8000000x32x64bins x20it", 4.4, 0.02)])
+    assert benchdiff.main(["--threshold", "0.15"] + files) == 1
+    err = capsys.readouterr().err
+    assert "gbdt.1000000x128x255bins_x10it.vs_baseline" in err
+
+    # unchanged rounds gate clean
+    _write_round(r2, 2, [rec("1000000x128x255bins x10it", 0.9, 0.05),
+                         rec("8000000x32x64bins x20it", 4.4, 0.02)])
+    assert benchdiff.main(["--threshold", "0.15"] + files) == 0
+    capsys.readouterr()
+
+
+def test_benchdiff_gbdt_gates_on_real_rounds():
+    """The committed BENCH_r0N.json history must parse and synthesize the
+    derived gate records without error (threshold-free informational
+    run)."""
+    import glob
+    files = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_r0*.json")))
+    if len(files) < 2:
+        pytest.skip("no committed bench rounds")
+    rounds = [benchdiff.load_round(f) for f in files]
+    labeled = [(f"r{i}", by) for i, (_, by) in enumerate(rounds)]
+    lines, _ = benchdiff.diff_rounds(labeled)
+    assert any("gbdt." in ln and ".vs_baseline" in ln for ln in lines)
 
 
 def test_benchdiff_natural_order_and_unreadable_input(tmp_path, capsys):
