@@ -50,6 +50,7 @@ from ..stages.batching import pad_rows_to_bucket, shape_bucket
 from ..telemetry.spans import get_tracer
 from ..telemetry import names as tnames
 from ..telemetry import perf as tperf
+from ..utils import tracing
 from .serving import Reply, _jsonable
 
 
@@ -329,7 +330,12 @@ class ServingTransform:
         serving worker activated (no-op when the batch is unsampled)."""
         with get_tracer().span(tnames.SERVING_PLAN_RUN_SPAN,
                                rows=len(good_idx)):
-            vals = np.asarray(run(data))
+            # the span times the batch; the annotation names the region
+            # on captured device profiles and notes its host wall into
+            # the roofline ledger (telemetry/profiler.py) — a triggered
+            # /debug/profile capture attributes serving device time here
+            with tracing.annotate(tnames.SERVING_PLAN_RUN_SPAN):
+                vals = np.asarray(run(data))
         prefix, suffix = self._prefix, self._suffix
         if vals.ndim == 1 and vals.dtype.kind == "f":
             # scalar-float fast path: Python float repr IS shortest

@@ -95,11 +95,14 @@ class _RegistryHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         reg: "ServiceRegistry" = self.server.registry  # type: ignore
         path = self.path.split("?", 1)[0]
-        if path in ("/metrics", "/metrics.json", "/slo", "/debug/bundle"):
+        if path in ("/metrics", "/metrics.json", "/slo", "/debug/bundle",
+                    "/debug/profile"):
             # full path rides through so ?window= reaches the handler;
             # /slo exposes the leader's own objectives (worker verdicts
             # come from scrape_cluster(slo=True)); /debug/bundle dumps
-            # the leader's flight-recorder bundle on demand
+            # the leader's flight-recorder bundle on demand, and
+            # /debug/profile captures a device profile of the leader
+            # (same 429/503/500 contract)
             from ..telemetry.exposition import metrics_http_response
             status, payload, ctype = metrics_http_response(self.path)
             self.send_response(status)

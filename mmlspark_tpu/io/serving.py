@@ -206,8 +206,12 @@ _REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
 # from serving.request.* metrics (a self-scrape must not move the SLO
 # it reports on). /debug/bundle is the on-demand flight-recorder dump
 # (telemetry/perf.py) — reachable even on a server whose workers are
-# wedged, which is exactly when you want the bundle.
-EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo", "/debug/bundle")
+# wedged, which is exactly when you want the bundle. /debug/profile is
+# the triggered device-profile capture (telemetry/profiler.py) with the
+# same 429/503/500 contract; its ?ms=N window blocks the handler, so it
+# is rate-limited and ms-clamped.
+EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo", "/debug/bundle",
+                    "/debug/profile")
 
 # Ingress bounds: a header block or body beyond these is rejected and the
 # connection closed — the single-threaded loop must never be wedged (or its

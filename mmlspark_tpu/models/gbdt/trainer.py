@@ -358,10 +358,14 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
         if depth == 0 or voting:
             # full histogram pass (voting masks features pre-psum, which is
-            # incompatible with sibling subtraction)
-            hg, hh, hc = node_feature_histograms(
-                bins, grad, hess, node_local, active, m, cfg.n_bins,
-                count_w=count_w, lo_planes=lo_planes, plane_lo=plane_lo)
+            # incompatible with sibling subtraction). The gbdt.hist
+            # named_scope rides into the compiled ops' metadata, so a
+            # captured device profile attributes their self time to the
+            # histogram region (telemetry/profiler.py REGIONS).
+            with jax.named_scope("gbdt.hist"):
+                hg, hh, hc = node_feature_histograms(
+                    bins, grad, hess, node_local, active, m, cfg.n_bins,
+                    count_w=count_w, lo_planes=lo_planes, plane_lo=plane_lo)
             if voting:
                 parent_g = psum(hg[:, 0].sum(-1))
                 parent_h = psum(hh[:, 0].sum(-1))
@@ -390,14 +394,15 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # for LEFT children only (even node_local), derive siblings as
             # parent - left. Halves both compute and psum volume per level.
             left_active = active & (node_local % 2 == 0)
-            lg, lh, lc = node_feature_histograms(
-                bins, grad, hess, node_local // 2, left_active, m // 2,
-                cfg.n_bins, count_w=count_w, lo_planes=lo_planes,
-                plane_lo=plane_lo)
-            lg, lh, lc = psum(lg), psum(lh), psum(lc)
-            hg = _interleave(lg, prev_hists[0] - lg)
-            hh = _interleave(lh, prev_hists[1] - lh)
-            hc = _interleave(lc, prev_hists[2] - lc)
+            with jax.named_scope("gbdt.hist"):
+                lg, lh, lc = node_feature_histograms(
+                    bins, grad, hess, node_local // 2, left_active, m // 2,
+                    cfg.n_bins, count_w=count_w, lo_planes=lo_planes,
+                    plane_lo=plane_lo)
+                lg, lh, lc = psum(lg), psum(lh), psum(lc)
+                hg = _interleave(lg, prev_hists[0] - lg)
+                hh = _interleave(lh, prev_hists[1] - lh)
+                hc = _interleave(lc, prev_hists[2] - lc)
             # children of non-split nodes inherit garbage hists — mask them
             child_valid = jnp.repeat(prev_apply, 2)
             parent_g, parent_h, parent_c = (hg[:, 0].sum(-1),
@@ -405,8 +410,9 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                             hc[:, 0].sum(-1))
         level_fmask = feature_mask if not voting else jnp.ones_like(feature_mask)
 
-        gain, feat, thr, is_cat, words = _best_splits_for_level(
-            hg, hh, hc, level_fmask, cfg, parent_g, parent_h, parent_c)
+        with jax.named_scope("gbdt.split"):
+            gain, feat, thr, is_cat, words = _best_splits_for_level(
+                hg, hh, hc, level_fmask, cfg, parent_g, parent_h, parent_c)
         gain = jnp.where(child_valid, gain, -jnp.inf)
         prev_hists = (hg, hh, hc)
 
@@ -442,40 +448,49 @@ def train_one_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # one (m, n) stripe gather + a fused select chain per level
             # (route_rows_level — the round-6 Amdahl cleanup of the former
             # 63-dynamic-slices-per-tree loop)
-            node_of_row = route_rows_level(
-                bins_t, node_of_row, node_local, feat, thr, apply,
-                level_base, m,
-                is_cat=is_cat if w16 else None,
-                words=words if w16 else None)
+            with jax.named_scope("gbdt.route"):
+                node_of_row = route_rows_level(
+                    bins_t, node_of_row, node_local, feat, thr, apply,
+                    level_base, m,
+                    is_cat=is_cat if w16 else None,
+                    words=words if w16 else None)
         else:
             # deep levels (m > 64): unrolling would blow up the program;
             # one-hot contractions cost O(n*(m+F)) but stay fully parallel.
-            node_oh = jax.nn.one_hot(node_local, m, dtype=jnp.float32)
-            cols = [feat.astype(jnp.float32), thr.astype(jnp.float32),
-                    apply.astype(jnp.float32)]
-            if w16:
-                # halfword membership columns stay exact in f32 (< 2^16)
-                cols.append(is_cat.astype(jnp.float32))
-            tbl = jnp.stack(cols, axis=1)
-            if w16:
-                tbl = jnp.concatenate([tbl, words.astype(jnp.float32)], axis=1)
-            # HIGHEST precision: bf16 operands would round feature ids > 256
-            rows = jnp.matmul(node_oh, tbl,
-                              precision=jax.lax.Precision.HIGHEST)  # (n, 3+)
-            row_feat = rows[:, 0].astype(jnp.int32)
-            row_thr = rows[:, 1].astype(jnp.int32)
-            row_apply = active & (rows[:, 2] > 0.5)
-            feat_oh = jax.nn.one_hot(row_feat, cfg.n_features, dtype=jnp.float32)
-            # elementwise multiply-reduce (not a dot) — stays exact in f32
-            row_bin = jnp.sum(bins.astype(jnp.float32) * feat_oh,
-                              axis=1).astype(jnp.int32)
-            go_left = row_bin <= row_thr
-            if w16:
-                row_words = rows[:, 4:4 + w16].astype(jnp.int32)  # (n, W16)
-                member = packed_member(row_bin, row_words)
-                go_left = jnp.where(rows[:, 3] > 0.5, member, go_left)
-            child = jnp.where(go_left, 2 * node_of_row + 1, 2 * node_of_row + 2)
-            node_of_row = jnp.where(row_apply, child, node_of_row)
+            with jax.named_scope("gbdt.route"):
+                node_oh = jax.nn.one_hot(node_local, m, dtype=jnp.float32)
+                cols = [feat.astype(jnp.float32), thr.astype(jnp.float32),
+                        apply.astype(jnp.float32)]
+                if w16:
+                    # halfword membership columns stay exact in f32 (< 2^16)
+                    cols.append(is_cat.astype(jnp.float32))
+                tbl = jnp.stack(cols, axis=1)
+                if w16:
+                    tbl = jnp.concatenate([tbl, words.astype(jnp.float32)],
+                                          axis=1)
+                # HIGHEST precision: bf16 operands would round feature
+                # ids > 256
+                rows = jnp.matmul(
+                    node_oh, tbl,
+                    precision=jax.lax.Precision.HIGHEST)  # (n, 3+)
+                row_feat = rows[:, 0].astype(jnp.int32)
+                row_thr = rows[:, 1].astype(jnp.int32)
+                row_apply = active & (rows[:, 2] > 0.5)
+                feat_oh = jax.nn.one_hot(row_feat, cfg.n_features,
+                                         dtype=jnp.float32)
+                # elementwise multiply-reduce (not a dot) — stays exact
+                # in f32
+                row_bin = jnp.sum(bins.astype(jnp.float32) * feat_oh,
+                                  axis=1).astype(jnp.int32)
+                go_left = row_bin <= row_thr
+                if w16:
+                    row_words = rows[:, 4:4 + w16].astype(
+                        jnp.int32)  # (n, W16)
+                    member = packed_member(row_bin, row_words)
+                    go_left = jnp.where(rows[:, 3] > 0.5, member, go_left)
+                child = jnp.where(go_left, 2 * node_of_row + 1,
+                                  2 * node_of_row + 2)
+                node_of_row = jnp.where(row_apply, child, node_of_row)
 
     # leaf values from resting nodes (shrinkage applied here, like LightGBM);
     # segment sums and the delta lookup as one-hot matmuls, not scatters

@@ -51,6 +51,7 @@ from typing import Callable, Optional, Sequence
 from ..telemetry.spans import get_tracer
 from ..telemetry import names as tnames
 from ..utils.checkpoint import CheckpointManager
+from ..utils.tracing import annotate as _annotate
 from .faults import FaultInjector, InjectedFault
 from .metrics import reliability_metrics
 from .policy import RetryPolicy
@@ -371,7 +372,14 @@ class TrainingSupervisor:
                                 self.clock.note(
                                     "lost",
                                     time.perf_counter() - t_fault)
-                        out = self._call_step(step_fn, step)
+                        # `train.step` region (telemetry/profiler.py):
+                        # a TraceAnnotation on captured profiles plus a
+                        # host-wall note into the roofline ledger, so
+                        # triggered captures attribute device time to
+                        # the step and roofline.json carries a
+                        # train.step row on every backend
+                        with _annotate("train.step"):
+                            out = self._call_step(step_fn, step)
                 except self.restart_on as e:
                     step, results = self._restart(e, seek)
                     continue

@@ -34,6 +34,13 @@ Pillars:
   recompile detector, device/host memory gauges sampled on every
   scrape, per-bucket trace exemplars on histograms, and the
   burn-triggered flight recorder (`GET /debug/bundle`).
+- **Device profiles** (`telemetry.profiler`): triggered on-device
+  capture (`GET /debug/profile`, straggler flags, burn latches) parsed
+  into per-op records and joined with compile-log cost into the
+  per-region roofline ledger (`op.<region>.*` gauges, roofline.json).
+- **Watch** (`telemetry.watch`): threshold + median-shift change-point
+  detection over poller series — live regressions trip events and
+  flight bundles instead of waiting for the next offline benchdiff.
 - **Hooks**: serving request path, `data.DevicePrefetcher`,
   `TrainingSupervisor` step/checkpoint lifecycle, `fit_booster`
   iterations, `utils.tracing.trace` device profiles (stamped with the
@@ -68,6 +75,12 @@ _LAZY_NAMES = {
     "TelemetryPoller": "poller",
     "StepClock": "goodput", "StragglerDetector": "goodput",
     "flops_from_compile_log": "goodput",
+    "ProfileSession": "profiler", "RooflineLedger": "profiler",
+    "get_profile_session": "profiler",
+    "configure_profile_session": "profiler",
+    "capture_profile": "profiler", "parse_trace": "profiler",
+    "get_roofline": "profiler", "resolve_peaks": "profiler",
+    "WatchRule": "watch", "TelemetryWatcher": "watch",
     "CompileLog": "perf", "FlightRecorder": "perf", "AotCache": "perf",
     "collective_traffic": "perf",
     "compile_with_analysis": "perf", "executable_analysis": "perf",
@@ -104,4 +117,8 @@ __all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
            "executable_analysis", "record_plan_compile", "get_compile_log",
            "compile_stats", "hbm_utilization", "sample_resource_gauges",
            "sample_resource_stats", "get_flight_recorder",
-           "configure_flight_recorder", "trigger_bundle"]
+           "configure_flight_recorder", "trigger_bundle",
+           "ProfileSession", "RooflineLedger", "get_profile_session",
+           "configure_profile_session", "capture_profile", "parse_trace",
+           "get_roofline", "resolve_peaks",
+           "WatchRule", "TelemetryWatcher"]

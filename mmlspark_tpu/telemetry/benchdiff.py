@@ -27,6 +27,14 @@ records — a kernel "win" that tanked either fails the diff:
 
     python -m mmlspark_tpu.telemetry.benchdiff --threshold 0.1 BENCH_r*.json
 
+Backend gating (round 11): records carry a ``backend`` annotation (from
+the record itself, or a round file's top-level ``backend`` declaration —
+bench.py stamps ``jax.default_backend()``); records measured on a
+non-TPU backend are excluded from both trajectories and gates and
+reported as excluded — BENCH_EXTRA_r06 is CPU-only (route fallback
+``xla``) and must not read as a perf datapoint. BENCH_EXTRA-style
+artifacts (records nested as top-level values) are harvested too.
+
 It also reads the ``MULTICHIP_r0N.json`` wrapper format (a driver
 object whose ``tail`` holds ``GPIPE_MSWEEP {json}`` / ``TRAFFIC
 {json}`` lines): the GPipe microbatch sweep becomes
@@ -119,7 +127,9 @@ def _gbdt_records(rec: dict) -> list:
     """Derived per-shape gate records from one GBDT headline record. The
     shape rides in the metric name so the wide rows (same metric string,
     earlier tail lines) gate independently of the canonical 8M headline
-    instead of being last-line-overwritten."""
+    instead of being last-line-overwritten. The parent's backend
+    annotation rides along — a CPU-only round's derived gates are
+    excluded exactly like its headline."""
     if rec.get("metric") != _GBDT_METRIC:
         return []
     tag = str(rec.get("shape", "headline")).replace(" ", "_") or "headline"
@@ -127,7 +137,10 @@ def _gbdt_records(rec: dict) -> list:
     for field in _GBDT_GATED_FIELDS:
         v = rec.get(field)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
-            out.append({"metric": f"gbdt.{tag}.{field}", "value": float(v)})
+            d = {"metric": f"gbdt.{tag}.{field}", "value": float(v)}
+            if rec.get("backend") is not None:
+                d["backend"] = rec["backend"]
+            out.append(d)
     return out
 
 
@@ -154,6 +167,16 @@ def _records_from_text(text: str) -> list:
         # harvest every bench line from the tail (multi-mode runs print
         # several), with `parsed` as the authoritative headline. The
         # MULTICHIP wrapper's tail carries TAGGED lines instead.
+        # BENCH_EXTRA-style artifacts nest whole records as top-level
+        # values (and declare the round's backend at top level) — harvest
+        # those too so an auto-emitted CPU round is SEEN and then
+        # excluded from gating by its backend, rather than invisible.
+        for v in obj.values():
+            if isinstance(v, dict) and "metric" in v:
+                records.append(dict(v))
+            elif isinstance(v, list):
+                records.extend(dict(e) for e in v
+                               if isinstance(e, dict) and "metric" in e)
         for line in str(obj.get("tail", "")).splitlines():
             line = line.strip()
             tagged = _TAGGED.match(line)
@@ -173,15 +196,29 @@ def _records_from_text(text: str) -> list:
                     continue
                 if isinstance(rec, dict) and "metric" in rec:
                     records.append(rec)
+        # a round-level backend declaration annotates every record that
+        # didn't carry its own (newer bench records do) — the per-record
+        # field is what gating reads. Annotation runs BEFORE derivation
+        # (derived gate records inherit from their parent) and applies
+        # to the authoritative `parsed` headline too — the re-added
+        # parsed copy below would otherwise gate as TPU.
+        file_backend = obj.get("backend")
+
+        def _annotated(rs: list) -> list:
+            if isinstance(file_backend, str):
+                for r in rs:
+                    r.setdefault("backend", file_backend)
+            return rs
+
         # derive BEFORE the parsed-headline dedup: the wide GBDT rows
         # share the headline's metric string and would be dropped by it,
         # but their per-shape derived gate records must survive
-        records = _with_derived(records)
+        records = _with_derived(_annotated(records))
         parsed = obj.get("parsed")
         if isinstance(parsed, dict) and "metric" in parsed:
             records = [r for r in records
                        if r.get("metric") != parsed["metric"]]
-            records.extend(_with_derived([parsed]))
+            records.extend(_with_derived(_annotated([dict(parsed)])))
         return records
     # JSONL fallback
     for line in text.splitlines():
@@ -214,6 +251,17 @@ def load_round(path: str) -> Tuple[object, dict]:
     return sort_key, by_metric
 
 
+def _perf_backend(rec: dict) -> bool:
+    """Is this record a perf-trajectory datapoint? Records ANNOTATED with
+    a non-TPU backend (bench.py stamps `jax.default_backend()`; wrapper
+    files may declare it round-wide) are real measurements of the wrong
+    hardware — a CPU fallback round reading as a 99.9% regression, or a
+    CPU round "recovering" to TPU reading as a win, would both poison
+    the gate. Unannotated records (historic rounds) gate as before."""
+    backend = rec.get("backend")
+    return backend is None or str(backend).lower() == "tpu"
+
+
 def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
                 threshold: Optional[float] = None,
                 lower_better: Tuple[str, ...] = ()) -> Tuple[list, list]:
@@ -221,16 +269,24 @@ def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
     A regression compares the LAST round's value against the most recent
     earlier round that carries the metric. A record born with
     ``"lower_better": true`` (MULTICHIP bubble/traffic synthesis) gates
-    as lower-is-better without a CLI flag."""
+    as lower-is-better without a CLI flag. Records whose ``backend``
+    annotation is non-TPU are EXCLUDED from both the trajectory and the
+    gate (reported as excluded, so the omission is visible)."""
     order: dict = {}   # metric -> [(label, value)] — dict keeps insertion order
     born_lower: set = set()
+    excluded: list = []
     for label, by_metric in rounds:
         for metric, rec in by_metric.items():
             v = rec.get(key)
-            if isinstance(v, (int, float)):
-                order.setdefault(metric, []).append((label, float(v)))
-                if rec.get("lower_better"):
-                    born_lower.add(metric)
+            if not isinstance(v, (int, float)):
+                continue
+            if not _perf_backend(rec):
+                excluded.append(f"{label} {metric} "
+                                f"(backend={rec.get('backend')})")
+                continue
+            order.setdefault(metric, []).append((label, float(v)))
+            if rec.get("lower_better"):
+                born_lower.add(metric)
     lines: list = []
     regressions: list = []
     for metric, series in order.items():
@@ -255,6 +311,8 @@ def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
                     f"{metric}: {prev:g} -> {last:g} "
                     f"({delta:+.1%}, threshold {threshold:.0%}"
                     f"{', lower-better' if lb else ''})")
+    for note in excluded:
+        lines.append(f"excluded from perf gates (non-TPU backend): {note}")
     return lines, regressions
 
 

@@ -212,6 +212,8 @@ def metrics_http_response(path: str, registry=None) -> tuple:
             "application/json"
     if base == "/debug/bundle":
         return _bundle_response()
+    if base == "/debug/profile":
+        return _profile_response(path)
     # every metrics scrape carries a FRESH memory sample: device
     # memory_stats + host RSS land in gauges right before export, so the
     # fleet's headroom rides next to its latency (telemetry/perf.py;
@@ -262,6 +264,48 @@ def _bundle_response() -> tuple:
         return 429, json.dumps(
             {"error": "bundle suppressed by rate limit",
              "min_interval_s": rec.min_interval_s}).encode(), \
+            "application/json"
+    return 200, json.dumps(manifest).encode(), "application/json"
+
+
+def _profile_response(path: str) -> tuple:
+    """GET /debug/profile?ms=N: capture a device profile for N ms and
+    answer the parsed manifest (per-op table + region rollup). Same
+    contract as /debug/bundle: 503 when no profile dir is configured,
+    429 when the rate limit suppressed the capture, 500 (with the slot
+    rolled back and the partial dir removed) on a failed capture; a
+    malformed ms answers 400. The capture blocks the handler for N ms —
+    ms is clamped to the session's max_ms, and the rate limit keeps a
+    scrape loop from turning the endpoint into a profiler DoS."""
+    from .profiler import get_profile_session
+    query = path.partition("?")[2]
+    values = urllib.parse.parse_qs(query).get("ms")
+    ms = None
+    if values:
+        try:
+            ms = float(values[-1])
+        except ValueError:
+            ms = float("nan")
+        if not (ms > 0.0):   # NaN fails too -> 400, like ?window=
+            return 400, json.dumps(
+                {"error": f"ms must be > 0, got {values[-1]!r}"}).encode(), \
+                "application/json"
+    session = get_profile_session()
+    if not session.enabled:
+        return 503, json.dumps(
+            {"error": "profiling disabled — set MMLSPARK_TPU_PROFILE_DIR "
+                      "or telemetry.profiler.configure_profile_session("
+                      "profile_dir=...)"}).encode(), "application/json"
+    try:
+        manifest = session.capture(ms=ms, reason="on-demand")
+    except Exception as e:  # noqa: BLE001 - a 500 beats a dropped scrape
+        return 500, json.dumps(
+            {"error": f"profile capture failed: {e}"}).encode(), \
+            "application/json"
+    if manifest is None:
+        return 429, json.dumps(
+            {"error": "profile suppressed by rate limit",
+             "min_interval_s": session.min_interval_s}).encode(), \
             "application/json"
     return 200, json.dumps(manifest).encode(), "application/json"
 

@@ -374,13 +374,19 @@ class StragglerDetector:
     the same check over the same files, so every host agrees."""
 
     def __init__(self, heartbeat, threshold: float = 1.5,
-                 min_steps: int = 4, registry=None, tracer=None):
+                 min_steps: int = 4, registry=None, tracer=None,
+                 profile_on_flag: bool = True):
         self.heartbeat = heartbeat
         self.threshold = float(threshold)
         self.min_steps = max(int(min_steps), 1)
         self._metrics = registry if registry is not None \
             else reliability_metrics
         self._tracer = tracer
+        # THIS host newly flagged -> one triggered device-profile capture
+        # (telemetry/profiler.py): the straggling host profiles itself at
+        # the moment it deviates. A no-op until a profile dir is
+        # configured; rate-limited by the session's own slot; absorbed.
+        self.profile_on_flag = bool(profile_on_flag)
         self._flagged: set = set()
 
     def check(self) -> list:
@@ -420,8 +426,21 @@ class StragglerDetector:
                              step_p50_ms=round(s["step_p50_ms"], 3),
                              fleet_p50_ms=round(s["fleet_p50_ms"], 3),
                              threshold=self.threshold)
+        own = getattr(self.heartbeat, "process_id", None)
+        capture_self = (self.profile_on_flag and own is not None
+                        and own in now_flagged and own not in self._flagged)
         self._flagged = now_flagged
         self._metrics.set_gauge(tnames.TRAIN_STRAGGLERS, len(now_flagged))
+        if capture_self:
+            # flag TRANSITION on this host: capture a device profile of
+            # the very steps that are straggling (ordered AFTER the
+            # train.straggler event in the span log — the capture's
+            # telemetry.profile event seq follows it causally)
+            try:
+                from .profiler import get_profile_session
+                get_profile_session().capture(reason="straggler")
+            except Exception:  # noqa: BLE001 - detection must not raise
+                pass
         return stragglers
 
 

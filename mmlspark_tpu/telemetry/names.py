@@ -69,6 +69,10 @@ PLAN_COLLECTIVE_BYTES = "plan.collective_bytes"
 SERVING_PLAN_EVICTIONS = "serving.plan.evictions"
 TELEMETRY_BUNDLE_DUMPS = "telemetry.bundle.dumps"
 TELEMETRY_BUNDLE_SUPPRESSED = "telemetry.bundle.suppressed"
+TELEMETRY_PROFILE_CAPTURES = "telemetry.profile.captures"
+TELEMETRY_PROFILE_SUPPRESSED = "telemetry.profile.suppressed"
+TELEMETRY_PROFILE_STAMP_ERRORS = "telemetry.profile.stamp_errors"
+TELEMETRY_WATCH_TRIPS = "telemetry.watch.trips"
 
 COUNTERS = {
     SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
@@ -134,6 +138,14 @@ COUNTERS = {
     TELEMETRY_BUNDLE_DUMPS: "flight-recorder debug bundles written",
     TELEMETRY_BUNDLE_SUPPRESSED: "flight-recorder triggers suppressed by "
                                  "the rate limit",
+    TELEMETRY_PROFILE_CAPTURES: "device-profile captures written "
+                                "(ProfileSession)",
+    TELEMETRY_PROFILE_SUPPRESSED: "profile triggers suppressed by the "
+                                  "capture rate limit",
+    TELEMETRY_PROFILE_STAMP_ERRORS: "trace_context.json stamps that "
+                                    "failed (capture kept, stamp lost)",
+    TELEMETRY_WATCH_TRIPS: "telemetry watcher rule trip TRANSITIONS "
+                           "(threshold or median-shift)",
     "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
                              "(process/thread)",
     "gbdt.hist.route.{route}": "histogram kernel-route selections "
@@ -158,6 +170,7 @@ TRAIN_GOODPUT = "train.goodput"
 TRAIN_MFU = "train.mfu"
 TRAIN_LOST_SECONDS = "train.lost_seconds"
 TRAIN_STRAGGLERS = "train.stragglers"
+TELEMETRY_WATCH_TRIPPED = "telemetry.watch.tripped"
 
 GAUGES = {
     GBDT_HIST_PLAN_BYTES: "resident level-invariant one-hot plane bytes "
@@ -182,10 +195,18 @@ GAUGES = {
                         "rewinds, injected stalls, failed step attempts)",
     TRAIN_STRAGGLERS: "hosts currently flagged by straggler detection "
                       "(windowed step p50 beyond threshold x fleet median)",
+    TELEMETRY_WATCH_TRIPPED: "telemetry watcher rules currently in the "
+                             "tripped state",
     "device{ordinal}.mem.bytes_in_use": "per-device bytes in use "
                                         "(memory_stats)",
     "device{ordinal}.mem.peak_bytes": "per-device peak bytes in use "
                                       "(memory_stats)",
+    "op.{region}.hbm_util": "per-region achieved / peak HBM bytes/s "
+                            "(RooflineLedger; absent when either side "
+                            "is unknown)",
+    "op.{region}.flops_util": "per-region achieved / peak FLOP/s "
+                              "(RooflineLedger; absent when either side "
+                              "is unknown)",
 }
 
 # ------------------------------------------------------------- histograms
@@ -278,6 +299,8 @@ TRAIN_RESTART_EVENT = "train.restart"
 TRAIN_PREEMPTED_EVENT = "train.preempted"
 TRAIN_STRAGGLER_EVENT = "train.straggler"
 TELEMETRY_BUNDLE_EVENT = "telemetry.bundle"
+TELEMETRY_PROFILE_EVENT = "telemetry.profile"
+TELEMETRY_WATCH_TRIP_EVENT = "telemetry.watch.trip"
 
 EVENTS = {
     FAULT_INJECTED_EVENT: "one FaultInjector firing (site, index, kind)",
@@ -286,6 +309,11 @@ EVENTS = {
                            "median attrs)",
     TELEMETRY_BUNDLE_EVENT: "one flight-recorder bundle written (reason, "
                             "path)",
+    TELEMETRY_PROFILE_EVENT: "one device-profile capture written "
+                             "(reason, path, parsed op count)",
+    TELEMETRY_WATCH_TRIP_EVENT: "a watched telemetry series breached its "
+                                "rule (key, kind, value, bound/baseline "
+                                "attrs)",
     TRAIN_RESUME_EVENT: "supervisor resumed from a checkpoint",
     TRAIN_RESTART_EVENT: "supervisor restarted the step loop from the "
                          "in-memory snapshot",
@@ -354,3 +382,13 @@ def train_step_phase(phase: str) -> str:
 def gbdt_hist_route(route: str) -> str:
     """gbdt.hist.route.{route} — per-route kernel-selection counter."""
     return f"gbdt.hist.route.{route}"
+
+
+def op_hbm_util(region: str) -> str:
+    """op.{region}.hbm_util — per-region roofline HBM utilization."""
+    return f"op.{region}.hbm_util"
+
+
+def op_flops_util(region: str) -> str:
+    """op.{region}.flops_util — per-region roofline FLOPs utilization."""
+    return f"op.{region}.flops_util"

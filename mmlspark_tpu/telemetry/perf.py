@@ -103,8 +103,18 @@ class CompileLog:
 
     def record(self, fingerprint, bucket, seconds: float,
                analysis: Optional[dict] = None,
-               label: Optional[str] = None, registry=None) -> dict:
+               label: Optional[str] = None, registry=None,
+               region: Optional[str] = None) -> dict:
         key = (str(fingerprint), bucket)
+        if region is None:
+            # a compile performed inside a utils.tracing.annotate region
+            # tags itself with it — the RooflineLedger's exact join key
+            # (never a guessed prefix match)
+            try:
+                from .profiler import current_region
+                region = current_region()
+            except Exception:  # noqa: BLE001 - a record without a region
+                region = None
         with self._lock:
             ent = self._keys.get(key)
             recompile = ent is not None
@@ -123,7 +133,8 @@ class CompileLog:
             rec = {"fingerprint": str(fingerprint), "bucket": bucket,
                    "seconds": float(seconds), "count": ent["count"],
                    "recompile": recompile, "t": wall_now(),
-                   "label": label, "analysis": analysis or None}
+                   "label": label, "region": region,
+                   "analysis": analysis or None}
             self._records.append(rec)
         if registry is None:
             registry = self._registry
@@ -527,13 +538,19 @@ class FlightRecorder:
     def __init__(self, bundle_dir: Optional[str] = None,
                  min_interval_s: float = 60.0, max_bundles: int = 8,
                  window_s: float = 60.0, registry=None, tracer=None,
-                 compile_log: Optional[CompileLog] = None):
+                 compile_log: Optional[CompileLog] = None,
+                 profile_on_burn: bool = False):
         if bundle_dir is None:
             bundle_dir = os.environ.get(BUNDLE_DIR_ENV) or None
         self.bundle_dir = bundle_dir
         self.min_interval_s = float(min_interval_s)
         self.max_bundles = max(int(max_bundles), 1)
         self.window_s = float(window_s)
+        # arm a device-profile capture on the same burn transition that
+        # dumped the bundle (telemetry/profiler.py; a no-op until a
+        # profile dir is configured, absorbed on failure — the bundle
+        # outranks the profile)
+        self.profile_on_burn = bool(profile_on_burn)
         self._registry = registry
         self._tracer = tracer
         self._compile_log = compile_log
@@ -551,7 +568,9 @@ class FlightRecorder:
 
     def configure(self, bundle_dir=None, min_interval_s: Optional[float]
                   = None, max_bundles: Optional[int] = None,
-                  window_s: Optional[float] = None) -> "FlightRecorder":
+                  window_s: Optional[float] = None,
+                  profile_on_burn: Optional[bool] = None
+                  ) -> "FlightRecorder":
         """Reconfigure in place (None leaves a knob untouched; pass
         bundle_dir="" to disable)."""
         with self._lock:
@@ -563,6 +582,8 @@ class FlightRecorder:
                 self.max_bundles = max(int(max_bundles), 1)
             if window_s is not None:
                 self.window_s = float(window_s)
+            if profile_on_burn is not None:
+                self.profile_on_burn = bool(profile_on_burn)
         return self
 
     # -- triggers ------------------------------------------------------------
@@ -592,6 +613,16 @@ class FlightRecorder:
         if manifest is not None:
             with self._lock:
                 self._burn_state[source] = True
+            if self.profile_on_burn:
+                # the burn latch also arms ONE device-profile capture:
+                # the bundle says WHAT burned, the profile says which op
+                # burned it. Rate-limited by the profile session's own
+                # slot; absorbed — the successful bundle already latched.
+                try:
+                    from .profiler import get_profile_session
+                    get_profile_session().capture(reason=str(reason))
+                except Exception:  # noqa: BLE001 - bundle outranks profile
+                    pass
         return manifest
 
     # -- the dump ------------------------------------------------------------
@@ -667,6 +698,12 @@ class FlightRecorder:
             # says where its steps' time went
             from .goodput import default_snapshot
             _json("goodput.json", default_snapshot())
+            # per-region roofline rows (telemetry/profiler.py): measured
+            # region time joined with compile-log cost against peaks —
+            # the bundle answers "where does the headroom live" per
+            # kernel, not just whole-fit ({} until anything was noted)
+            from .profiler import roofline_export
+            _json("roofline.json", roofline_export())
             manifest = {"reason": str(reason), "tag": tag, "seq": seq,
                         "pid": os.getpid(), "t": wall_now(), "path": path,
                         "files": files, "tracer": tracer.stats(),

@@ -28,6 +28,7 @@ registry hiccup leaves a gap in the series, never a dead poller.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from collections import deque
 from typing import Optional
@@ -38,6 +39,21 @@ from .exposition import scrape_cluster
 from .spans import wall_now
 
 
+def _newest_within(lines: list, max_bytes: int) -> list:
+    """The newest suffix of `lines` whose total size fits `max_bytes`
+    (the newest line always survives — a bound must truncate history,
+    never the present)."""
+    total = 0
+    keep: list = []
+    for line in reversed(lines):
+        if keep and total + len(line) > max_bytes:
+            break
+        keep.append(line)
+        total += len(line)
+    keep.reverse()
+    return keep
+
+
 class TelemetryPoller:
     """Bounded-retention fleet poller (see module docstring)."""
 
@@ -45,11 +61,24 @@ class TelemetryPoller:
                  interval_s: float = 10.0, window_s: Optional[float] = 60.0,
                  history: int = 720, timeout: float = 5.0,
                  slo: bool = True, flight_on_burn: bool = False,
-                 kind: Optional[str] = None):
+                 kind: Optional[str] = None,
+                 jsonl_path: Optional[str] = None,
+                 jsonl_max_bytes: int = 16 * 1024 * 1024,
+                 clock=None):
         if interval_s <= 0.0:
             raise ValueError("interval_s must be > 0")
         self.registry_address = registry_address
         self.name = name
+        # continuous JSONL sink with size-bounded rotation: every sample
+        # appends one line; when the file exceeds jsonl_max_bytes the
+        # OLDEST lines are dropped (atomic rewrite) — a watcher that
+        # polls for weeks cannot fill the disk, same bounded-retention
+        # contract as the in-memory deque
+        self.jsonl_path = jsonl_path
+        self.jsonl_max_bytes = max(int(jsonl_max_bytes), 1024)
+        # injectable wall clock for sample timestamps (tests pin
+        # retention/rotation without sleeping)
+        self._clock = clock if clock is not None else wall_now
         # None polls every registered endpoint (serving AND trainers —
         # their registry `kind` entries make the mix explicit); set to
         # "serving"/"trainer" to watch one class
@@ -103,7 +132,7 @@ class TelemetryPoller:
         snap = scrape_cluster(self.registry_address, name=self.name,
                               timeout=self.timeout, window=self.window_s,
                               slo=self.slo, kind=self.kind)
-        sample = {"t": wall_now(),
+        sample = {"t": self._clock(),
                   "workers": snap.merged.get("telemetry.scrape.workers", 0),
                   "window_s": snap.merged.get("telemetry.scrape.window_s"),
                   "metrics": snap.merged,
@@ -111,6 +140,14 @@ class TelemetryPoller:
         with self._lock:
             self._samples.append(sample)
         reliability_metrics.inc(tnames.TELEMETRY_POLL_SAMPLES)
+        if self.jsonl_path is not None:
+            # outside the lock: disk I/O must never serialize readers.
+            # Failures count as poll errors but keep the in-memory series
+            # (the loop absorbs; manual poll_once callers see them too)
+            try:
+                self._append_jsonl(sample)
+            except OSError:
+                reliability_metrics.inc(tnames.TELEMETRY_POLL_ERRORS)
         if self.flight_on_burn and snap.slo is not None:
             try:
                 from .perf import get_flight_recorder
@@ -144,14 +181,39 @@ class TelemetryPoller:
                 out.append((s["t"], v))
         return out
 
-    def export_jsonl(self, path: str) -> int:
+    def export_jsonl(self, path: str,
+                     max_bytes: Optional[int] = None) -> int:
         """One sample per line, oldest first — the offline-fitting feed
-        (same convention as `Tracer.export_jsonl`)."""
+        (same convention as `Tracer.export_jsonl`). `max_bytes` bounds
+        the file by dropping the OLDEST samples first (the newest always
+        survives)."""
         samples = self.samples()
+        lines = [json.dumps(s) + "\n" for s in samples]
+        if max_bytes is not None:
+            lines = _newest_within(lines, max_bytes)
         with open(path, "w") as f:
-            for s in samples:
-                f.write(json.dumps(s) + "\n")
-        return len(samples)
+            f.writelines(lines)
+        return len(lines)
+
+    def _append_jsonl(self, sample: dict) -> None:
+        """Append one sample line; rotate (oldest lines dropped, atomic
+        tmp+replace) when the file exceeds `jsonl_max_bytes`."""
+        line = json.dumps(sample) + "\n"
+        with open(self.jsonl_path, "a") as f:
+            f.write(line)
+        if os.path.getsize(self.jsonl_path) <= self.jsonl_max_bytes:
+            return
+        with open(self.jsonl_path) as f:
+            lines = f.readlines()
+        # rotate down to HALF the bound: trimming to exactly max_bytes
+        # would leave the file full and re-trigger this whole-file
+        # read+rewrite on every subsequent append — halving amortizes
+        # the rewrite to once per ~half-bound of new samples
+        keep = _newest_within(lines, self.jsonl_max_bytes // 2)
+        tmp = self.jsonl_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(keep)
+        os.replace(tmp, self.jsonl_path)
 
     def stats(self) -> dict:
         with self._lock:

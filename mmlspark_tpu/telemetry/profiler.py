@@ -1,0 +1,701 @@
+"""Device-profile observability: triggered on-device capture, per-op
+parse, and per-region roofline attribution.
+
+ROADMAP item 1 made `hbm_utilization` the honesty metric of the
+histogram roofline chase, but the tree could only compute it for the
+WHOLE fit — 1.8% at BENCH_r05 with nothing able to say which op burns
+the other 98%. This module is the fourth observability tier
+(docs/observability.md "Device profiling & roofline"): the sensors that
+turn "the fit is memory-idle" into "gbdt.hist achieves X% of peak HBM
+and gbdt.route none of it" — the per-op (cost-analysis, measured-time)
+pairs *A Learned Performance Model for TPUs* (PAPERS.md) trains on and
+the ROADMAP item-4 autotuner's measured rows.
+
+- **ProfileSession**: programmatic `jax.profiler` start/stop with the
+  flight-recorder discipline — disabled until a profile dir is
+  configured (env ``MMLSPARK_TPU_PROFILE_DIR``), min-interval rate
+  limiting (`telemetry.profile.suppressed`), bounded retention (oldest
+  capture dirs pruned), and failure ROLLBACK (a failed capture gives the
+  rate-limit slot back and removes its partial dir, so it can never
+  shadow the next trigger). Triggers: `GET /debug/profile?ms=N` (same
+  429/503/500 contract as `/debug/bundle`), a `StragglerDetector` flag
+  transition on the flagged host, an SLO burn via the recorder latch
+  (`FlightRecorder(profile_on_burn=True)`), and `utils.tracing.trace`
+  (the explicit block-capture API, rebased on `session()`).
+- **parse_trace**: the captured trace (TensorBoard trace-event JSON,
+  ``plugins/profile/*/​*.trace.json.gz``) parsed into per-op records
+  ``{op, region, occurrences, self_time_us}`` from the DEVICE planes.
+  Field-by-field graceful degradation, mirroring `executable_analysis`'s
+  never-raise contract: on the CPU backend device planes are absent and
+  the table is empty — capture still succeeds, regions still carry their
+  host-noted walls. `region` resolves by matching the registered region
+  names (`REGIONS`) against op names/metadata — the
+  `jax.named_scope`/`TraceAnnotation` stamps the GBDT tree build
+  (`gbdt.hist`/`gbdt.split`/`gbdt.route`), `serving.plan.run`, and
+  `train.step` now carry.
+- **RooflineLedger**: joins per-region measured time (device-plane
+  self-time when a parse provided it, host-noted wall otherwise) with
+  `CompileLog` cost analysis into achieved FLOP/s and HBM bytes/s
+  against peak (env/chip table, `resolve_peaks`). Exported as
+  `op.<region>.{hbm_util,flops_util}` gauges, the `roofline.json`
+  section of every flight bundle, and the `roofline` block of bench.py's
+  headline record. A side that is unknown (no peak declared, no cost
+  analysis for the region) leaves its gauge ABSENT — never guessed,
+  same contract as MFU.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..reliability.metrics import reliability_metrics
+from . import names as tnames
+from .spans import get_tracer, wall_now
+
+PROFILE_DIR_ENV = "MMLSPARK_TPU_PROFILE_DIR"
+# default capture window for TRIGGERED captures (ms); explicit callers
+# and ?ms=N override
+PROFILE_MS_ENV = "MMLSPARK_TPU_PROFILE_MS"
+PEAK_HBM_ENV = "MMLSPARK_TPU_PEAK_HBM_GBPS"
+
+# Canonical trace-annotation region names: what the parser attributes
+# per-op device time to, and the keys of the roofline ledger / the
+# op.<region>.* gauges. The GBDT tree build stamps its three phases with
+# jax.named_scope (trace-time: the names ride the compiled ops' metadata
+# into the device planes); host-side hot paths stamp
+# utils.tracing.annotate (TraceAnnotation + host wall note).
+REGIONS = ("gbdt.hist", "gbdt.split", "gbdt.route",
+           "serving.plan.run", "train.step")
+
+# per-chip peaks (bf16 TFLOP/s, HBM GB/s) keyed on device_kind
+# substrings — the StepClock-style fallback when no env override is set.
+# Spec-sheet numbers, labeled as such in resolve_peaks()["source"].
+CHIP_PEAKS = (
+    ("v6e", 918.0, 1640.0),
+    ("v5p", 459.0, 2765.0),
+    ("v5e", 197.0, 819.0),
+    ("v5 lite", 197.0, 819.0),
+    ("v4", 275.0, 1228.0),
+)
+
+_REASON_RE = re.compile(r"[^a-zA-Z0-9_-]+")
+
+# active region (utils.tracing.annotate sets it): CompileLog.record reads
+# it so a compile performed inside a region lands with an exact join key
+_region_var: contextvars.ContextVar = contextvars.ContextVar(
+    "mmlspark_tpu_region", default=None)
+
+
+def current_region() -> Optional[str]:
+    """The innermost active `utils.tracing.annotate` region, or None."""
+    return _region_var.get()
+
+
+# ---------------------------------------------------------------- peaks
+def peak_hbm_from_env() -> Optional[float]:
+    """Peak HBM bytes/s from ``MMLSPARK_TPU_PEAK_HBM_GBPS`` (GB/s), or
+    None — the documented degrade on hosts that never declared one."""
+    raw = os.environ.get(PEAK_HBM_ENV)
+    if not raw:
+        return None
+    try:
+        gbps = float(raw)
+    except ValueError:
+        return None
+    return gbps * 1e9 if gbps > 0 else None
+
+
+def _chip_peaks() -> Optional[tuple]:
+    """(flops_per_s, hbm_bytes_per_s, kind) from the local device kind —
+    only consulted when jax is ALREADY imported (a passive read must
+    never pay a cold jax import), and only for kinds in CHIP_PEAKS."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+        kind = str(getattr(jax.devices()[0], "device_kind", ""))
+    except Exception:  # noqa: BLE001 - no backend: no chip peaks
+        return None
+    low = kind.lower()
+    for token, tflops, gbps in CHIP_PEAKS:
+        if token in low:
+            return tflops * 1e12, gbps * 1e9, kind
+    return None
+
+
+def resolve_peaks(peaks: Optional[dict] = None) -> dict:
+    """{"flops_per_s", "hbm_bytes_per_s", "source"} with explicit args
+    > env (``MMLSPARK_TPU_PEAK_TFLOPS`` / ``MMLSPARK_TPU_PEAK_HBM_GBPS``)
+    > chip table. A side nobody declared stays None — downstream
+    utilization gauges are then absent, never guessed."""
+    out = {"flops_per_s": None, "hbm_bytes_per_s": None, "source": None}
+    if peaks:
+        out["flops_per_s"] = peaks.get("flops_per_s")
+        out["hbm_bytes_per_s"] = peaks.get("hbm_bytes_per_s")
+        out["source"] = peaks.get("source", "explicit")
+        if (out["flops_per_s"] is not None
+                and out["hbm_bytes_per_s"] is not None):
+            return out
+    from .goodput import peak_flops_from_env
+    env_flops = peak_flops_from_env()
+    env_hbm = peak_hbm_from_env()
+    if out["flops_per_s"] is None and env_flops is not None:
+        out["flops_per_s"] = env_flops
+        out["source"] = out["source"] or "env"
+    if out["hbm_bytes_per_s"] is None and env_hbm is not None:
+        out["hbm_bytes_per_s"] = env_hbm
+        out["source"] = out["source"] or "env"
+    if out["flops_per_s"] is None or out["hbm_bytes_per_s"] is None:
+        chip = _chip_peaks()
+        if chip is not None:
+            if out["flops_per_s"] is None:
+                out["flops_per_s"] = chip[0]
+            if out["hbm_bytes_per_s"] is None:
+                out["hbm_bytes_per_s"] = chip[1]
+            out["source"] = out["source"] or f"chip-table:{chip[2]}"
+    return out
+
+
+# ----------------------------------------------------------- trace parse
+_MAX_OP_RECORDS = 512
+
+
+def _trace_files(log_dir: str) -> list:
+    """The capture's ``*.trace.json.gz`` files, newest profile run first
+    (jax writes ``plugins/profile/<timestamp>/<host>.trace.json.gz``)."""
+    runs = sorted(glob.glob(os.path.join(
+        log_dir, "plugins", "profile", "*")), reverse=True)
+    for run in runs:
+        files = sorted(glob.glob(os.path.join(run, "*.trace.json.gz")))
+        if files:
+            return files
+    return []
+
+
+def _region_of(name: str, args: Optional[dict]) -> str:
+    """First registered region token found in the op name or its string
+    metadata (named_scope paths ride `long_name`-style args on TPU
+    planes); 'other' when none match."""
+    for region in REGIONS:
+        if region in name:
+            return region
+    if args:
+        for v in args.values():
+            if isinstance(v, str):
+                for region in REGIONS:
+                    if region in v:
+                        return region
+    return "other"
+
+
+def parse_trace(log_dir: str) -> list:
+    """Per-op records from a captured profile's DEVICE planes:
+    ``[{op, region, occurrences, self_time_us}]``, largest self-time
+    first, bounded. NEVER raises (the `executable_analysis` contract):
+    a missing/torn trace file, an unexpected schema, or a backend with
+    no device planes (CPU) all degrade to an empty table field by
+    field."""
+    ops: dict = {}
+    for path in _trace_files(log_dir):
+        try:
+            with gzip.open(path, "rt") as f:
+                obj = json.load(f)
+        except Exception:  # noqa: BLE001 - torn capture: skip the file
+            continue
+        events = obj.get("traceEvents") if isinstance(obj, dict) else None
+        if not isinstance(events, list):
+            continue
+        device_pids = set()
+        for e in events:
+            if not isinstance(e, dict) or e.get("ph") != "M":
+                continue
+            if e.get("name") != "process_name":
+                continue
+            pname = str((e.get("args") or {}).get("name", ""))
+            # device planes are named "/device:TPU:0 ..." (the CPU
+            # backend exposes only "/host:CPU" — no device plane, empty
+            # table, the documented degrade)
+            if pname.startswith("/device:"):
+                device_pids.add(e.get("pid"))
+        if not device_pids:
+            continue
+        for e in events:
+            if not isinstance(e, dict) or e.get("ph") != "X":
+                continue
+            if e.get("pid") not in device_pids:
+                continue
+            name = str(e.get("name", ""))
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)):
+                continue
+            args = e.get("args") if isinstance(e.get("args"), dict) else None
+            key = (name, _region_of(name, args))
+            ent = ops.get(key)
+            if ent is None:
+                ops[key] = ent = {"op": name, "region": key[1],
+                                  "occurrences": 0, "self_time_us": 0.0}
+            ent["occurrences"] += 1
+            ent["self_time_us"] += float(dur)
+    records = sorted(ops.values(),
+                     key=lambda r: (-r["self_time_us"], r["op"]))
+    for r in records:
+        r["self_time_us"] = round(r["self_time_us"], 3)
+    return records[:_MAX_OP_RECORDS]
+
+
+def region_totals(records: list) -> dict:
+    """{region: {"self_time_us", "occurrences"}} rollup of a per-op
+    table (what the ledger ingests after a capture)."""
+    out: dict = {}
+    for r in records:
+        ent = out.setdefault(r.get("region", "other"),
+                             {"self_time_us": 0.0, "occurrences": 0})
+        ent["self_time_us"] += float(r.get("self_time_us", 0.0))
+        ent["occurrences"] += int(r.get("occurrences", 0))
+    return out
+
+
+# -------------------------------------------------------- roofline ledger
+class RooflineLedger:
+    """Per-region achieved-vs-peak accounting (module docstring).
+
+    Two measurement sources feed it: `note_region` (host wall from
+    `utils.tracing.annotate` — exists on every backend) and `ingest_ops`
+    (device-plane self time from a parsed capture — overrides the host
+    wall for regions it covers, labeled ``source: device``). Costs join
+    per region from the CompileLog (records whose ``region`` tag or
+    label matches) or explicitly via `set_cost` (bench's analytic
+    traffic). All state is bounded: regions are a handful of names, ops
+    keep the last parse only."""
+
+    def __init__(self, registry=None, compile_log=None,
+                 peaks: Optional[dict] = None):
+        self._registry = registry
+        self._compile_log = compile_log
+        self._peaks = peaks
+        self._lock = threading.Lock()
+        self._host: dict = {}     # region -> [seconds, occurrences]
+        self._device: dict = {}   # region -> {"self_time_us", "occurrences"}
+        self._ops: list = []      # last parsed per-op table (bounded)
+        self._costs: dict = {}    # region -> {"flops", "bytes_accessed"}
+
+    # -- measurement feeds ---------------------------------------------------
+    def note_region(self, region: str, seconds: float,
+                    occurrences: int = 1, source: str = "host") -> None:
+        """Accumulate wall-clock region time measured OUTSIDE a device
+        plane. `source` labels the provenance honestly ("host" for
+        annotate walls, bench passes "bench-phase" for its in-graph
+        phase programs); device-plane self time from a parse overrides
+        these rows entirely."""
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            ent = self._host.setdefault(region, [0.0, 0, str(source)])
+            ent[0] += s
+            ent[1] += int(occurrences)
+            ent[2] = str(source)
+
+    def ingest_ops(self, records: list) -> None:
+        """Adopt a parsed per-op table: device-plane region totals
+        REPLACE earlier device totals (a capture is a fresh window, not
+        a cumulative series)."""
+        totals = region_totals(records)
+        totals.pop("other", None)
+        with self._lock:
+            self._ops = list(records)
+            if totals:
+                self._device = totals
+
+    def set_cost(self, region: str, flops: Optional[float] = None,
+                 bytes_accessed: Optional[float] = None) -> None:
+        """Declare a region's PER-OCCURRENCE cost explicitly (bench's
+        analytic histogram traffic; a caller that knows its executable's
+        cost analysis). None leaves that side unknown."""
+        with self._lock:
+            ent = self._costs.setdefault(region, {})
+            if flops is not None:
+                ent["flops"] = float(flops)
+            if bytes_accessed is not None:
+                ent["bytes_accessed"] = float(bytes_accessed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._host.clear()
+            self._device.clear()
+            self._costs.clear()
+            self._ops = []
+
+    # -- the join ------------------------------------------------------------
+    def _cost_of(self, region: str) -> Optional[dict]:
+        # explicit declarations win; else the newest compile record
+        # tagged with (or labeled as) the region — an exact join key,
+        # not a guessed prefix match
+        cost = self._costs.get(region)
+        if cost:
+            return dict(cost)
+        log = self._compile_log
+        if log is None:
+            from .perf import get_compile_log
+            log = get_compile_log()
+        for rec in reversed(log.records()):
+            if rec.get("region") != region and rec.get("label") != region:
+                continue
+            analysis = rec.get("analysis") or {}
+            out = {}
+            for field in ("flops", "bytes_accessed"):
+                v = analysis.get(field)
+                if isinstance(v, (int, float)) and v > 0:
+                    out[field] = float(v)
+            if out:
+                return out
+        return None
+
+    def rows(self, peaks: Optional[dict] = None) -> dict:
+        """{region: row} with measured seconds/occurrences (+source),
+        per-occurrence cost when known, achieved FLOP/s and HBM bytes/s,
+        and utilizations when the matching peak is known. Absent keys ARE
+        the degrade — a consumer must not find a guessed 0.0."""
+        resolved = resolve_peaks(peaks if peaks is not None else self._peaks)
+        with self._lock:
+            host = {k: list(v) for k, v in self._host.items()}
+            device = {k: dict(v) for k, v in self._device.items()}
+            costs_known = set(self._costs)
+        out: dict = {}
+        for region in sorted(set(host) | set(device) | costs_known):
+            if region in device:
+                seconds = device[region]["self_time_us"] / 1e6
+                occurrences = device[region]["occurrences"]
+                source = "device"
+            elif region in host:
+                seconds, occurrences, source = host[region]
+            else:
+                continue   # a cost with no measurement yet: nothing to say
+            row = {"seconds": round(seconds, 6),
+                   "occurrences": int(occurrences), "source": source}
+            cost = self._cost_of(region)
+            if cost and seconds > 0.0:
+                for field, achieved_key, peak_key, util_key in (
+                        ("flops", "achieved_flops_per_s", "flops_per_s",
+                         "flops_util"),
+                        ("bytes_accessed", "achieved_hbm_bytes_per_s",
+                         "hbm_bytes_per_s", "hbm_util")):
+                    per_occ = cost.get(field)
+                    if per_occ is None:
+                        continue
+                    row[field] = per_occ
+                    achieved = per_occ * occurrences / seconds
+                    row[achieved_key] = round(achieved, 1)
+                    peak = resolved.get(peak_key)
+                    if peak:
+                        # 9 decimals: a genuinely tiny utilization (a
+                        # long host wall over a fast chip, ~1e-8) must
+                        # not round to a 0.0 that reads as guessed
+                        row[util_key] = round(achieved / peak, 9)
+            out[region] = row
+        return out
+
+    def publish(self, registry=None) -> dict:
+        """Set the `op.<region>.{hbm_util,flops_util}` gauges for every
+        region whose utilization is computable; absent sides set
+        nothing. Returns the rows it published from."""
+        reg = registry if registry is not None else (
+            self._registry if self._registry is not None
+            else reliability_metrics)
+        rows = self.rows()
+        for region, row in rows.items():
+            if "hbm_util" in row:
+                reg.set_gauge(tnames.op_hbm_util(region), row["hbm_util"])
+            if "flops_util" in row:
+                reg.set_gauge(tnames.op_flops_util(region),
+                              row["flops_util"])
+        return rows
+
+    def export(self) -> dict:
+        """The roofline.json body: peaks (with provenance), per-region
+        rows, and the last parsed per-op table."""
+        with self._lock:
+            ops = list(self._ops)
+        return {"t": wall_now(),
+                "peaks": resolve_peaks(self._peaks),
+                "regions": self.rows(),
+                "ops": ops}
+
+
+_default_ledger = RooflineLedger()
+
+
+def get_roofline() -> RooflineLedger:
+    return _default_ledger
+
+
+def note_region(region: str, seconds: float) -> None:
+    """Host-wall region note into the process-default ledger
+    (`utils.tracing.annotate` calls this on every region exit)."""
+    _default_ledger.note_region(region, seconds)
+
+
+@contextlib.contextmanager
+def region(name: str):
+    """Activate `name` as the current region for the block (compile
+    records made inside tag themselves with it) — the contextvar half of
+    `utils.tracing.annotate`, split out so the profiler owns the key."""
+    token = _region_var.set(name)
+    try:
+        yield
+    finally:
+        _region_var.reset(token)
+
+
+def roofline_export() -> dict:
+    """The default ledger's export — what FlightRecorder.dump writes as
+    roofline.json. Never raises (a bundle without roofline beats no
+    bundle)."""
+    try:
+        return _default_ledger.export()
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _stamp_context(log_dir: str, ctx, registry=None) -> bool:
+    """Stamp a profile dir with the active trace id
+    (`trace_context.json`) so the on-disk artifact and the span log
+    cross-reference each other. The capture outranks the stamp — but the
+    old silent `pass` on failure hid real breakage, so a failed stamp is
+    counted under `telemetry.profile.stamp_errors`."""
+    reg = registry if registry is not None else reliability_metrics
+    try:
+        with open(os.path.join(log_dir, "trace_context.json"), "w") as f:
+            json.dump({"trace_id": ctx.trace_id,
+                       "span_id": ctx.span_id}, f)
+        return True
+    except OSError:
+        reg.inc(tnames.TELEMETRY_PROFILE_STAMP_ERRORS)
+        return False
+
+
+# --------------------------------------------------------- ProfileSession
+class ProfileSession:
+    """Rate-limited, bounded, rollback-safe device-profile capture
+    (module docstring). Disabled (every trigger a cheap no-op / 503)
+    until a profile dir is configured via env ``MMLSPARK_TPU_PROFILE_DIR``
+    or `configure(profile_dir=...)`; `utils.tracing.trace` passes an
+    explicit log_dir + force=True and works regardless."""
+
+    def __init__(self, profile_dir: Optional[str] = None,
+                 min_interval_s: float = 60.0, max_profiles: int = 4,
+                 max_ms: float = 10_000.0, registry=None, tracer=None,
+                 ledger: Optional[RooflineLedger] = None):
+        if profile_dir is None:
+            profile_dir = os.environ.get(PROFILE_DIR_ENV) or None
+        self.profile_dir = profile_dir
+        self.min_interval_s = float(min_interval_s)
+        self.max_profiles = max(int(max_profiles), 1)
+        self.max_ms = float(max_ms)
+        self._registry = registry
+        self._tracer = tracer
+        self._ledger = ledger
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last: Optional[float] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.profile_dir is not None
+
+    def configure(self, profile_dir=None,
+                  min_interval_s: Optional[float] = None,
+                  max_profiles: Optional[int] = None,
+                  max_ms: Optional[float] = None) -> "ProfileSession":
+        """Reconfigure in place (None leaves a knob untouched; pass
+        profile_dir="" to disable)."""
+        with self._lock:
+            if profile_dir is not None:
+                self.profile_dir = profile_dir or None
+            if min_interval_s is not None:
+                self.min_interval_s = float(min_interval_s)
+            if max_profiles is not None:
+                self.max_profiles = max(int(max_profiles), 1)
+            if max_ms is not None:
+                self.max_ms = float(max_ms)
+        return self
+
+    def default_ms(self) -> float:
+        """Capture window for triggered captures (straggler flags, burn
+        latches): env ``MMLSPARK_TPU_PROFILE_MS``, default 200, clamped
+        to max_ms."""
+        raw = os.environ.get(PROFILE_MS_ENV)
+        try:
+            ms = float(raw) if raw else 200.0
+        except ValueError:
+            ms = 200.0
+        return min(max(ms, 1.0), self.max_ms)
+
+    # -- the capture primitive -----------------------------------------------
+    @contextlib.contextmanager
+    def session(self, reason: str = "trace",
+                log_dir: Optional[str] = None, force: bool = False,
+                create_perfetto_link: bool = False):
+        """Capture a device profile around the enclosed block; yields an
+        info dict that gains ``ops``/``regions``/``path`` at exit.
+
+        One capture path for every entry point: rate-limit gate (skipped
+        with force=True — the explicit `utils.tracing.trace` API keeps
+        its unconditional behavior), `device.profile` span, the
+        trace-context stamp (`trace_context.json`, stamp failures
+        counted under `telemetry.profile.stamp_errors`), per-op parse,
+        ledger feed, retention pruning. A suppressed capture yields
+        ``{"suppressed": True}`` and runs the block unprofiled; a FAILED
+        capture rolls the rate-limit slot back, removes the partial
+        capture dir (never a caller-owned log_dir), and raises."""
+        reg = self._registry if self._registry is not None \
+            else reliability_metrics
+        own_dir = log_dir is None
+        if own_dir and not self.enabled:
+            raise RuntimeError(
+                "ProfileSession disabled — set MMLSPARK_TPU_PROFILE_DIR "
+                "or configure(profile_dir=...)")
+        now = time.monotonic()
+        with self._lock:
+            if (not force and self._last is not None
+                    and now - self._last < self.min_interval_s):
+                suppressed = True
+                prev_last = seq = None
+            else:
+                suppressed = False
+                prev_last = self._last
+                self._last = now
+                seq = self._seq
+                self._seq += 1
+        if suppressed:
+            reg.inc(tnames.TELEMETRY_PROFILE_SUPPRESSED)
+            yield {"suppressed": True}
+            return
+        tag = _REASON_RE.sub("-", str(reason))[:48] or "profile"
+        if own_dir:
+            log_dir = os.path.join(self.profile_dir,
+                                   f"profile-{os.getpid()}-{seq:04d}-{tag}")
+        tracer = self._tracer if self._tracer is not None else get_tracer()
+        info = {"path": log_dir, "reason": str(reason), "tag": tag,
+                "t": wall_now()}
+        started = False
+        span = None
+
+        def _rollback():
+            # a failed capture must not shadow the next trigger for
+            # min_interval_s, keep a partial dir in the retention
+            # budget, or leak an unfinished span — same contract on the
+            # block path AND the finalization path (stop_trace can fail
+            # on a full disk)
+            if span is not None:
+                span.finish(error="capture-failed")
+            with self._lock:
+                if self._last == now:
+                    self._last = prev_last
+            if own_dir:
+                shutil.rmtree(log_dir, ignore_errors=True)
+
+        try:
+            import jax
+            os.makedirs(log_dir, exist_ok=True)
+            span = tracer.start_span(tnames.DEVICE_PROFILE_SPAN,
+                                     attrs={"log_dir": log_dir})
+            jax.profiler.start_trace(
+                log_dir, create_perfetto_link=create_perfetto_link)
+            started = True
+            yield info
+        except BaseException:
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 - already torn down
+                    pass
+            _rollback()
+            raise
+        try:
+            jax.profiler.stop_trace()
+            ctx = span.context if span is not None else tracer.current()
+            if ctx is not None:
+                _stamp_context(log_dir, ctx, reg)
+            ops = parse_trace(log_dir)
+            info["ops"] = ops
+            info["regions"] = region_totals(ops)
+            ledger = self._ledger if self._ledger is not None \
+                else _default_ledger
+            ledger.ingest_ops(ops)
+            ledger.publish(registry=reg)
+        except BaseException:
+            _rollback()
+            raise
+        if span is not None:
+            span.finish(ops=len(ops))
+        if own_dir:
+            self._prune()
+        reg.inc(tnames.TELEMETRY_PROFILE_CAPTURES)
+        tracer.event(tnames.TELEMETRY_PROFILE_EVENT, reason=str(reason),
+                     path=log_dir, ops=len(ops))
+
+    def capture(self, ms: Optional[float] = None,
+                reason: str = "on-demand",
+                force: bool = False) -> Optional[dict]:
+        """Timed capture: profile for `ms` (clamped to max_ms) and return
+        the manifest, or None when the rate limit suppressed it. Same
+        trigger contract as `FlightRecorder.dump`: /debug/profile maps
+        None to 429, disabled to 503, and a raised failure to 500."""
+        if not self.enabled:
+            return None
+        if ms is None:
+            ms = self.default_ms()
+        ms = min(max(float(ms), 1.0), self.max_ms)
+        with self.session(reason=reason, force=force) as info:
+            if info.get("suppressed"):
+                return None
+            time.sleep(ms / 1000.0)
+        info["ms"] = ms
+        return info
+
+    def _prune(self) -> None:
+        """Keep the newest `max_profiles` capture dirs (mtime order);
+        best-effort — losing a race to a concurrent prune is harmless."""
+        try:
+            entries = [os.path.join(self.profile_dir, e)
+                       for e in os.listdir(self.profile_dir)
+                       if e.startswith("profile-")]
+            entries.sort(key=lambda p: (os.path.getmtime(p), p))
+            for stale in entries[:-self.max_profiles]:
+                shutil.rmtree(stale, ignore_errors=True)
+        except OSError:
+            pass
+
+
+_session: Optional[ProfileSession] = None
+_session_lock = threading.Lock()
+
+
+def get_profile_session() -> ProfileSession:
+    global _session
+    with _session_lock:
+        if _session is None:
+            _session = ProfileSession()
+        return _session
+
+
+def configure_profile_session(**kwargs) -> ProfileSession:
+    """Configure the process-default profile session (see
+    `ProfileSession.configure`)."""
+    return get_profile_session().configure(**kwargs)
+
+
+def capture_profile(ms: Optional[float] = None, reason: str = "manual",
+                    force: bool = False) -> Optional[dict]:
+    """One-liner timed capture on the process-default session (the
+    public application API; triggers use the same path)."""
+    return get_profile_session().capture(ms=ms, reason=reason, force=force)

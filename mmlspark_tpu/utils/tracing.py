@@ -2,23 +2,28 @@
 subsystem; pairs with the Timer stage for wall-clock and utils.stopwatch for
 code blocks).
 
-`trace(dir)` wraps jax.profiler.trace — the resulting trace opens in
+`trace(dir)` wraps device-profile capture — the resulting trace opens in
 TensorBoard/Perfetto and shows per-op device time, the ground truth for the
-fusion/HBM questions this framework's perf work keeps asking. annotate()
+fusion/HBM questions this framework's perf work keeps asking. `annotate()`
 marks named regions inside a trace.
 
-Telemetry integration (docs/observability.md): when a request/span context
-is active, `trace()` stamps the profile directory with the trace id
-(`trace_context.json`) and records a `device.profile` span — a slow request
-in the span log links straight to the device profile that explains it.
-`wall_clock(..., tracer=...)` routes a timed block into the telemetry
-tracer as a span instead of printing.
+Telemetry integration (docs/observability.md): `trace()` is rebased on
+`telemetry.profiler.ProfileSession` — ONE capture path shared with the
+triggered captures (`GET /debug/profile`, straggler flags, burn latches),
+so every capture gets the same `device.profile` span, the same
+`trace_context.json` trace-id stamp (stamp failures counted under
+`telemetry.profile.stamp_errors` instead of silently passed), and the same
+per-op parse feeding the roofline ledger. `annotate(name)` additionally
+notes the region's host wall into that ledger and activates the region for
+compile-record tagging, so per-region rows exist even on backends whose
+profiles carry no device planes (CPU). `wall_clock(..., tracer=...)`
+routes a timed block into the telemetry tracer as a span instead of
+printing.
 """
 from __future__ import annotations
 
 import contextlib
-import json
-import os
+import sys
 import time
 
 
@@ -28,39 +33,47 @@ def trace(log_dir: str, create_perfetto_link: bool = False):
 
         with tracing.trace("/tmp/trace"):
             model.fit(table)
-    """
-    import jax
-    from ..telemetry.names import DEVICE_PROFILE_SPAN
-    from ..telemetry.spans import get_tracer
-    os.makedirs(log_dir, exist_ok=True)
-    tracer = get_tracer()
-    span = tracer.start_span(DEVICE_PROFILE_SPAN,
-                             attrs={"log_dir": log_dir})
-    jax.profiler.start_trace(log_dir,
-                             create_perfetto_link=create_perfetto_link)
-    try:
+
+    Rebased on `telemetry.profiler.ProfileSession.session` (force=True:
+    the explicit API is never rate-limited, and the caller owns
+    `log_dir` — no retention pruning). The `device.profile` span and the
+    `trace_context.json` stamp are unchanged from the pre-session
+    behavior."""
+    from ..telemetry.profiler import get_profile_session
+    with get_profile_session().session(
+            reason="trace", log_dir=log_dir, force=True,
+            create_perfetto_link=create_perfetto_link):
         yield log_dir
-    finally:
-        jax.profiler.stop_trace()
-        ctx = span.context if span is not None else tracer.current()
-        if ctx is not None:
-            # stamp the profile with the active trace id so the on-disk
-            # artifact and the span log cross-reference each other
-            try:
-                with open(os.path.join(log_dir,
-                                       "trace_context.json"), "w") as f:
-                    json.dump({"trace_id": ctx.trace_id,
-                               "span_id": ctx.span_id}, f)
-            except OSError:
-                pass   # profile capture outranks the stamp
-        if span is not None:
-            span.finish()
 
 
+@contextlib.contextmanager
 def annotate(name: str):
-    """Named region inside a trace (jax.profiler.TraceAnnotation)."""
-    import jax
-    return jax.profiler.TraceAnnotation(name)
+    """Named region inside a trace (jax.profiler.TraceAnnotation on the
+    host timeline) that ALSO feeds the roofline ledger: the region's host
+    wall is noted on exit (`telemetry.profiler.note_region`) and any
+    compile recorded inside tags itself with the region — so
+    `roofline.json` carries per-region rows on every backend, refined to
+    device-plane self time where a parse provided it. jax is only
+    touched when already imported (annotating must never pay a cold jax
+    import on a hot path)."""
+    from ..telemetry import profiler as _prof
+    cm = None
+    if "jax" in sys.modules:
+        try:
+            import jax
+            cm = jax.profiler.TraceAnnotation(name)
+        except Exception:  # noqa: BLE001 - a backend without profiler
+            cm = None
+    t0 = time.perf_counter()
+    try:
+        with _prof.region(name):
+            if cm is not None:
+                with cm:
+                    yield
+            else:
+                yield
+    finally:
+        _prof.note_region(name, time.perf_counter() - t0)
 
 
 @contextlib.contextmanager
