@@ -861,6 +861,105 @@ def _bench_telemetry():
         "exposition_bytes": len(expo_text), **out}))
 
 
+def _bench_quality():
+    """Model-quality tap overhead A/B (ISSUE 12 satellite): the SAME
+    closed-loop serving harness as BENCH_MODE=serving (real fitted GBDT
+    booster with its fit-time reference profile, compiled fast path,
+    coalesced microbatch) runs three times —
+
+    - off:     sketches and the label join disabled (monitor installed,
+               sample 0 — the per-batch cost is one boolean test),
+    - sampled: live sketches head-sampled at 10% by request id + the
+               label-join prediction insert per request (the recommended
+               always-on production setting),
+    - full:    every request folded into the sketches,
+
+    — and reports req/s + p50 per mode. BUDGET (asserted HERE, never in
+    tier-1 — wall clock on a contended host is bench territory): the
+    sampled mode must stay within 20% of off. The full run also scrapes
+    GET /metrics once (drift gauges must publish) and GET /quality (the
+    export must carry live sketch counts == requests served), so the
+    artifact proves the quality exposition live under load. The record
+    is stamped with `backend` so benchdiff gates it correctly."""
+    import urllib.request
+    import jax
+    from mmlspark_tpu.core import Table
+    from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.reliability.metrics import reliability_metrics
+    from mmlspark_tpu.telemetry import quality as tquality
+
+    rng = np.random.default_rng(0)
+    n, f = 20_000, 16
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    model = GBDTClassifier(num_iterations=20, max_depth=5).fit(
+        Table({"features": x, "label": y}))
+    body = json.dumps({"features": [0.1] * f})
+
+    n_clients, per_client = 16, 125
+    out = {}
+    quality_payload = {}
+    for tag, rate, labels in (("off", 0.0, False), ("sampled", 0.1, True),
+                              ("full", 1.0, True)):
+        tquality.reset_monitor()
+        reliability_metrics.reset("serving.")
+        reliability_metrics.reset("quality.")
+        server, q = serve_pipeline(model, input_cols=["features"],
+                                   mode="microbatch", max_batch=256,
+                                   batch_linger_ms=0.2, fast_path=True)
+        tquality.configure_quality(sample=rate, labels=labels)
+        host, port = server._httpd.server_address[:2]
+        try:
+            res = run_load(host, port, body, n_clients=n_clients,
+                           per_client=per_client)
+            assert not res.errors, res.errors[:3]
+            if tag == "full":
+                expo = urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=10
+                ).read().decode()
+                assert "quality_drift_max" in expo, \
+                    "full run published no drift gauge on GET /metrics"
+                quality_payload = json.loads(urllib.request.urlopen(
+                    f"http://{host}:{port}/quality", timeout=10).read())
+        finally:
+            q.stop()
+            server.stop()
+        out[f"{tag}_req_per_sec"] = round(res.req_per_sec, 1)
+        out[f"{tag}_p50_ms"] = round(res.p50_ms, 2)
+        out[f"{tag}_p99_ms"] = round(res.p99_ms, 2)
+        out[f"{tag}_sketch_rows"] = reliability_metrics.get(
+            "quality.sketch.rows")
+    tquality.reset_monitor()
+
+    total = n_clients * per_client
+    assert out["off_sketch_rows"] == 0
+    assert out["full_sketch_rows"] == total, \
+        (out["full_sketch_rows"], total)
+    live = quality_payload.get("live", {}).get("columns", {})
+    assert live.get("f0", {}).get("hist", {}).get("count") == total, \
+        "GET /quality under load lost live sketch counts"
+    out["sampled_overhead_pct"] = round(
+        (1.0 - out["sampled_req_per_sec"]
+         / max(out["off_req_per_sec"], 1e-9)) * 100.0, 1)
+    out["full_overhead_pct"] = round(
+        (1.0 - out["full_req_per_sec"]
+         / max(out["off_req_per_sec"], 1e-9)) * 100.0, 1)
+    out["sampled_overhead_budget_pct"] = 20.0
+    assert out["sampled_overhead_pct"] <= out["sampled_overhead_budget_pct"], \
+        (f"10% quality sampling cost {out['sampled_overhead_pct']}% "
+         f"throughput — over the "
+         f"{out['sampled_overhead_budget_pct']}% budget")
+    print(json.dumps({
+        "metric": "serving_quality_sampled_req_per_sec",
+        "value": out["sampled_req_per_sec"], "unit": "req/s",
+        # >= ~1.0 means the sampled tap is throughput-free within noise
+        "vs_baseline": round(out["sampled_req_per_sec"]
+                             / max(out["off_req_per_sec"], 1e-9), 3),
+        "backend": jax.default_backend(), **out}))
+
+
 def _bench_ckpt():
     """Checkpoint stall per training step, sync vs async (ISSUE 4
     tooling satellite): the SAME LM stream-training loop runs (a) with no
@@ -1338,6 +1437,8 @@ def main():
         return _bench_ckpt()
     if mode == "telemetry":
         return _bench_telemetry()
+    if mode == "quality":
+        return _bench_quality()
     if mode == "hist":
         return _bench_hist()
     # predict/shap modes never print the bandwidth fields — don't spend the

@@ -12,12 +12,12 @@ from .chunk import Chunk, ChunkSource, default_chunk_rows, make_chunks
 from .pool import WorkerCrashError, WorkerPool
 from .prefetch import DevicePrefetcher, prefetch_to_device
 from .pipeline import (IngestOptions, IngestPipeline, ParallelTransform,
-                       parallel_apply_bins, stage_binned)
+                       parallel_apply_bins, profile_columns, stage_binned)
 
 __all__ = [
     "Chunk", "ChunkSource", "default_chunk_rows", "make_chunks",
     "WorkerPool", "WorkerCrashError",
     "DevicePrefetcher", "prefetch_to_device",
     "IngestOptions", "IngestPipeline", "ParallelTransform",
-    "parallel_apply_bins", "stage_binned",
+    "parallel_apply_bins", "profile_columns", "stage_binned",
 ]

@@ -164,6 +164,30 @@ def stage_binned(mapper, x: np.ndarray, opts: Optional[IngestOptions] = None,
     return d_bins
 
 
+def profile_columns(profile, columns: dict, chunk_rows: int = 0,
+                    max_rows: int = 0):
+    """Fold named column arrays into a `telemetry.quality.DatasetProfile`
+    in row CHUNKS — the ingest-side reference-profile tap. Chunked
+    folding is the point, not an optimization: each chunk merges through
+    the sketches' exact merge kernel (counts sum, Welford combine), so
+    the profile a chunked ingest produces is the same state a fleet
+    merge of per-worker profiles produces — pinned by
+    tests/test_quality.py. `max_rows` bounds the fold (0 = all rows);
+    columns must share a row count (chunking is by row range)."""
+    if not columns:
+        return profile
+    names = sorted(columns)
+    n = min(int(np.asarray(columns[c]).shape[0]) for c in names)
+    if max_rows:
+        n = min(n, int(max_rows))
+    chunk_rows = chunk_rows or default_chunk_rows(n, len(names), 1)
+    for chunk in make_chunks(n, chunk_rows):
+        for name in names:
+            profile.observe(name,
+                            np.asarray(columns[name])[chunk.lo:chunk.hi])
+    return profile
+
+
 class ParallelTransform:
     """Wrap a row-independent Table->Table transform so it maps over row
     chunks on the worker pool with order-preserving reassembly — the drop-in

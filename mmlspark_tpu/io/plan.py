@@ -39,7 +39,7 @@ import math
 import threading
 import time
 from collections import OrderedDict
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -50,6 +50,7 @@ from ..stages.batching import pad_rows_to_bucket, shape_bucket
 from ..telemetry.spans import get_tracer
 from ..telemetry import names as tnames
 from ..telemetry import perf as tperf
+from ..telemetry import quality as tquality
 from ..utils import tracing
 from .serving import Reply, _jsonable
 
@@ -120,7 +121,17 @@ class ServingTransform:
     share it: the dict lookup is lock-guarded but plans themselves are
     stateless closures, so the lock covers nanoseconds — partitions scale
     without a per-partition copy while jax's jit cache (process-global
-    anyway) still sees one stable shape per bucket."""
+    anyway) still sees one stable shape per bucket.
+
+    **Model-quality tap** (telemetry/quality.py): a served model carrying
+    a `quality_profile` (the GBDT estimators freeze one at fit time)
+    installs it as the process reference profile, and every served batch
+    feeds the live sketches + the delayed-label join — head-sampled by
+    request id, a no-op boolean test when no profile is installed.
+    `wants_request_ids` tells the serving worker to pass each row's
+    request id (== `X-Request-Id` == trace id), the label-join key."""
+
+    wants_request_ids = True
 
     def __init__(self, model, input_cols: Sequence[str],
                  output_col: str = "prediction", max_bucket: int = 4096,
@@ -164,6 +175,16 @@ class ServingTransform:
         # per-row value between these fragments
         self._prefix = ('{"%s": ' % output_col).encode()
         self._suffix = b"}"
+        # reference-profile install: the model's frozen fit-time profile
+        # becomes the process quality reference (last served model wins —
+        # multi-model tenancy is ROADMAP item 3 stretch). Guarded: a
+        # malformed profile loses quality observability, never serving.
+        profile = getattr(self.model, "quality_profile", None)
+        if profile:
+            try:
+                tquality.get_monitor().set_reference(profile)
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- plan construction ---------------------------------------------------
     # A plan is an (assemble, run) pair: `assemble` converts parsed rows to
@@ -285,7 +306,8 @@ class ServingTransform:
                     "capacity": self.max_plans}
 
     # -- the transform -------------------------------------------------------
-    def __call__(self, bodies: Sequence[bytes]) -> list:
+    def __call__(self, bodies: Sequence[bytes],
+                 request_ids: Optional[Sequence[str]] = None) -> list:
         rows, replies = _decode_rows(bodies, self.input_cols)
         good_idx = [i for i, r in enumerate(rows) if r is not None]
         if not good_idx:
@@ -318,12 +340,13 @@ class ServingTransform:
                 # answered and nothing rides the replay machinery for
                 # what is client-shaped data
                 for i, _, single in survivors:
-                    self._run_rows([i], single, run, replies)
+                    self._run_rows([i], single, run, replies, request_ids)
                 return replies
-        self._run_rows(good_idx, data, run, replies)
+        self._run_rows(good_idx, data, run, replies, request_ids)
         return replies
 
-    def _run_rows(self, good_idx: list, data, run, replies: list) -> None:
+    def _run_rows(self, good_idx: list, data, run, replies: list,
+                  request_ids: Optional[Sequence[str]] = None) -> None:
         """Execute the plan and encode one reply per row. Exceptions from
         `run` are SERVER faults and propagate to the worker's replay/502
         machinery untouched. The span joins the ambient request trace the
@@ -336,6 +359,14 @@ class ServingTransform:
             # /debug/profile capture attributes serving device time here
             with tracing.annotate(tnames.SERVING_PLAN_RUN_SPAN):
                 vals = np.asarray(run(data))
+        # model-quality tap: live distribution sketches + the delayed-
+        # label join (telemetry/quality.py). One boolean test when no
+        # reference profile is installed; head-sampled by request id
+        # otherwise. Never raises into the serving worker.
+        tquality.observe_serving(
+            data, vals,
+            None if request_ids is None
+            else [request_ids[i] for i in good_idx])
         prefix, suffix = self._prefix, self._suffix
         if vals.ndim == 1 and vals.dtype.kind == "f":
             # scalar-float fast path: Python float repr IS shortest

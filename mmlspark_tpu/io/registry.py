@@ -28,7 +28,7 @@ from typing import NamedTuple, Optional
 from ..reliability.policy import RetryPolicy
 from ..telemetry.spans import get_tracer
 from ..telemetry import names as tnames
-from .serving import _ThreadingServer
+from .serving import EXPOSITION_PATHS, _ThreadingServer
 
 
 class ServiceInfo(NamedTuple):
@@ -95,14 +95,15 @@ class _RegistryHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         reg: "ServiceRegistry" = self.server.registry  # type: ignore
         path = self.path.split("?", 1)[0]
-        if path in ("/metrics", "/metrics.json", "/slo", "/debug/bundle",
-                    "/debug/profile"):
+        if path in EXPOSITION_PATHS:
             # full path rides through so ?window= reaches the handler;
             # /slo exposes the leader's own objectives (worker verdicts
             # come from scrape_cluster(slo=True)); /debug/bundle dumps
-            # the leader's flight-recorder bundle on demand, and
+            # the leader's flight-recorder bundle on demand,
             # /debug/profile captures a device profile of the leader
-            # (same 429/503/500 contract)
+            # (same 429/503/500 contract), and /quality exports the
+            # leader's own model-quality state (worker exports come from
+            # scrape_cluster(quality=True))
             from ..telemetry.exposition import metrics_http_response
             status, payload, ctype = metrics_http_response(self.path)
             self.send_response(status)

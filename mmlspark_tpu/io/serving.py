@@ -209,9 +209,12 @@ _REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
 # wedged, which is exactly when you want the bundle. /debug/profile is
 # the triggered device-profile capture (telemetry/profiler.py) with the
 # same 429/503/500 contract; its ?ms=N window blocks the handler, so it
-# is rate-limited and ms-clamped.
-EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo", "/debug/bundle",
-                    "/debug/profile")
+# is rate-limited and ms-clamped. /quality is the model-quality export
+# (telemetry/quality.py): reference/live sketch states, drift rows, and
+# streaming-eval state — scrape_cluster(quality=True) merges it
+# fleet-wide.
+EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo", "/quality",
+                    "/debug/bundle", "/debug/profile")
 
 # Ingress bounds: a header block or body beyond these is rejected and the
 # connection closed — the single-threaded loop must never be wedged (or its
@@ -971,7 +974,7 @@ class ServingQuery:
                         if r._event.is_set():
                             continue  # already answered (expired to 504)
                         try:
-                            reply = self.transform_fn([r.body])[0]
+                            reply = self._transform([r])[0]
                             self._reply_one(r, reply)
                         except Exception as row_e:  # noqa: BLE001
                             self.server.reply_to(r.id, {"error": str(row_e)},
@@ -982,6 +985,18 @@ class ServingQuery:
                     # no commit -> epoch unchanged -> history replays;
                     # brief backoff so a failing loop doesn't hot-spin
                     time.sleep(0.01 * replays)
+
+    def _transform(self, live: list) -> list:
+        """Run the transform over a batch of CachedRequests. A transform
+        that declares `wants_request_ids` (the compiled fast path,
+        io/plan.py) also receives each row's request id — the id the
+        client reads back as `X-Request-Id`, which keys the model-quality
+        delayed-label join (telemetry/quality.py)."""
+        bodies = [r.body for r in live]
+        if getattr(self.transform_fn, "wants_request_ids", False):
+            return self.transform_fn(bodies,
+                                     request_ids=[r.id for r in live])
+        return self.transform_fn(bodies)
 
     def _reply_one(self, r, reply):
         if isinstance(reply, Reply):
@@ -998,7 +1013,6 @@ class ServingQuery:
             return
         reliability_metrics.set_gauge(tnames.SERVING_BATCH_OCCUPANCY,
                                       len(live) / max(self.max_batch, 1))
-        bodies = [r.body for r in live]
         # trace context rides into the transform: nested spans (the
         # compiled-plan run in io/plan.py, downstream RegistryClient posts)
         # attach under the batch's FIRST sampled request — a coalesced
@@ -1008,9 +1022,9 @@ class ServingQuery:
         t0 = time.perf_counter()
         if parent is not None:
             with tracer.use(parent):
-                replies = self.transform_fn(bodies)
+                replies = self._transform(live)
         else:
-            replies = self.transform_fn(bodies)
+            replies = self._transform(live)
         t1 = time.perf_counter()
         if parent is not None:
             # one transform span PER SAMPLED REQUEST (each parented to its

@@ -130,6 +130,12 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         "(reliability.AsyncCheckpointWriter) so the boosting loop never "
         "blocks on disk; the final/early-stop checkpoint stays synchronous",
         True)
+    quality_profile = Param(
+        "quality_profile",
+        "freeze a reference feature/label/prediction distribution profile "
+        "at fit time (telemetry.quality; bounded head sample) — serving "
+        "installs it so live drift gauges and the /quality export compare "
+        "the serving stream against THIS fit's data", True)
 
     def _boost_params(self, objective: str, num_class: int = 1) -> BoostParams:
         return BoostParams(
@@ -330,6 +336,48 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         return self.num_tasks > 1 or (self.num_tasks == 0 and
                                       jax.device_count() > 1)
 
+    def _attach_quality_profile(self, table: Table, model,
+                                score_rows: int = 8192):
+        """Freeze the fit-time reference profile onto the fitted model
+        (ISSUE 12 tentpole tap (1): the ingest/fit-time reference the
+        serving-stream live sketches drift against). Bounded: quantile
+        grids + sketch counts come from a head sample
+        (`quality.MAX_REFERENCE_ROWS`), folded CHUNK BY CHUNK through
+        `data.pipeline.profile_columns` — the same exact merge the fleet
+        scrape uses — plus label and head-sample model predictions. The
+        profile rides the model as a JSON-safe state dict, so it travels
+        with the plan payload into `compile_serving_transform`. Guarded:
+        profiling must never fail a fit."""
+        if not self.quality_profile:
+            return model
+        try:
+            from ...data.pipeline import profile_columns
+            from ...telemetry import quality as tquality
+            x = np.asarray(table[self.features_col],
+                           np.float32)[:tquality.MAX_REFERENCE_ROWS]
+            y = np.asarray(table[self.label_col],
+                           np.float64)[:tquality.MAX_REFERENCE_ROWS]
+            feature_cols = tquality.matrix_columns(x)
+            categorical = tuple(
+                f"f{int(i)}" for i in (self.categorical_slot_indexes or ()))
+            head = Table({self.features_col: x[:score_rows]})
+            pred = np.asarray(
+                model.transform(head)[self.prediction_col], np.float64)
+            all_cols = dict(feature_cols)
+            all_cols["label"] = y
+            all_cols["prediction"] = pred
+            # grids frozen over the full bounded sample, counts folded
+            # chunk-wise (ingest-shaped, exact-merge path)
+            prof = tquality.DatasetProfile.fit(
+                all_cols, categorical=categorical, observe=False)
+            profile_columns(prof, feature_cols)
+            prof.observe("label", y)
+            prof.observe("prediction", pred)
+            model.quality_profile = prof.state()
+        except Exception:  # noqa: BLE001 - observability never fails a fit
+            pass
+        return model
+
 
 class _GBDTModelBase(Model, HasFeaturesCol, HasPredictionCol):
     """Shared scoring surface (reference: LightGBMModelMethods.scala)."""
@@ -422,7 +470,7 @@ class GBDTClassifier(Estimator, _GBDTParams, HasProbabilitiesCol):
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col,
             sigmoid=self.sigmoid)
-        return m
+        return self._attach_quality_profile(table, m)
 
 
 class GBDTClassificationModel(_GBDTModelBase, HasProbabilitiesCol):
@@ -485,11 +533,12 @@ class GBDTRegressor(Estimator, _GBDTParams):
 
     def _fit(self, table: Table) -> "GBDTRegressionModel":
         booster, base, _ = self._train(table, self.objective)
-        return GBDTRegressionModel(
+        m = GBDTRegressionModel(
             booster=booster, init_score=base,
             features_col=self.features_col, prediction_col=self.prediction_col,
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col)
+        return self._attach_quality_profile(table, m)
 
 
 class GBDTRegressionModel(_GBDTModelBase):
@@ -525,11 +574,12 @@ class GBDTRanker(Estimator, _GBDTParams):
         _, group_ids = np.unique(groups_raw, return_inverse=True)
         booster, base, _ = self._train(table, "lambdarank",
                                        group=group_ids.astype(np.int32))
-        return GBDTRankerModel(
+        m = GBDTRankerModel(
             booster=booster, init_score=base,
             features_col=self.features_col, prediction_col=self.prediction_col,
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col)
+        return self._attach_quality_profile(table, m)
 
 
 class GBDTRankerModel(_GBDTModelBase):

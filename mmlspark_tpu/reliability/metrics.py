@@ -96,6 +96,29 @@ class Histogram:
     error regardless of how many samples arrive (the reason over a raw
     sample list: a day of traffic must not grow memory).
 
+    **External bucket grids**: by default every Histogram shares the
+    module-level geometric latency grid (1 us .. 80 s); `bounds` swaps in
+    an externally-built grid of strictly-increasing upper edges — the
+    value-domain form the quality sketches (telemetry/quality.py) build
+    from reference-data quantiles. A custom-grid histogram keeps the
+    whole mergeable-state contract: `state()` carries the grid under
+    `"bounds"`, `from_state()` reconstructs it, and the round-trip is
+    exact for the empty (all-zero counts, `min_ms: None`) and
+    single-observation edges (pinned in tests/test_quality.py). Negative
+    values are legal on a custom grid (feature domains are signed;
+    latency's clamp-at-zero applies only to the default grid), and
+    `percentile` falls back to the arithmetic bucket midpoint where a
+    geometric one is undefined (lo <= 0). Custom-grid histograms live
+    OUTSIDE the MetricsRegistry (exposition renders only the shared
+    grid); `.state()` keys stay `sum_ms`/`min_ms`/`max_ms` for wire
+    compatibility even when the unit is not milliseconds.
+
+    **Merging**: `merge_state(state)` folds another histogram's raw state
+    into this one — bucket counts sum elementwise (grids must match
+    exactly), count/sum add, min/max extend; never averaged. It is the
+    single merge kernel the cross-worker scrape merge and the quality
+    sketches' chunk/fleet folds both reduce to.
+
     **Trace exemplars**: an observation that carries a `trace_id` leaves
     a last-per-bucket exemplar `(trace_id, ms, wall_ts)` — the link from
     a burning p99 bucket back to the tail-captured span tree of a request
@@ -106,15 +129,29 @@ class Histogram:
     nothing."""
 
     __slots__ = ("name", "_counts", "_count", "_sum_ms", "_min_ms",
-                 "_max_ms", "_lock", "window", "_exemplars")
+                 "_max_ms", "_lock", "window", "_exemplars", "_bounds")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, bounds: Optional[tuple] = None):
         self.name = name
-        self._counts = [0] * _HIST_BUCKETS
+        if bounds is None:
+            self._bounds = _HIST_BOUNDS
+        else:
+            b = tuple(float(x) for x in bounds)
+            if not b or any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
+                raise ValueError(
+                    "bounds must be a non-empty strictly-increasing grid "
+                    "of bucket upper edges")
+            self._bounds = b
+        self._counts = [0] * (len(self._bounds) + 1)
         self._count = 0
         self._sum_ms = 0.0
         self._min_ms = float("inf")
-        self._max_ms = 0.0
+        # custom (value-domain) grids may be all-negative: the running
+        # max must start below any legal observation there. The default
+        # latency grid keeps 0.0 (observations are clamped >= 0 and the
+        # empty-state export stays byte-identical to older writers).
+        self._max_ms = 0.0 if self._bounds is _HIST_BOUNDS \
+            else float("-inf")
         self._lock = threading.Lock()
         # time-sharded ring (telemetry/window.py), attached by the
         # registry: cumulative and windowed views share ONE bisect per
@@ -123,9 +160,11 @@ class Histogram:
         self._exemplars: dict = {}   # bucket idx -> (trace_id, ms, ts)
 
     def observe_ms(self, ms: float, trace_id: Optional[str] = None) -> None:
-        if ms < 0.0:
+        if ms < 0.0 and self._bounds is _HIST_BOUNDS:
+            # the latency grid starts at 0; custom (value-domain) grids
+            # carry signed observations unclamped
             ms = 0.0
-        idx = bisect_right(_HIST_BOUNDS, ms)
+        idx = bisect_right(self._bounds, ms)
         if trace_id is not None:
             # timestamped OUTSIDE the lock (one perf_counter read); only
             # exemplar-carrying observations pay it
@@ -170,18 +209,26 @@ class Histogram:
             for idx, c in enumerate(self._counts):
                 seen += c
                 if seen >= target:
-                    if idx >= len(_HIST_BOUNDS):
+                    if idx >= len(self._bounds):
                         return self._max_ms   # open-ended overflow bucket
-                    lo = _HIST_BOUNDS[idx - 1] if idx > 0 else 0.0
-                    hi = _HIST_BOUNDS[idx]
-                    rep = (lo * hi) ** 0.5 if lo > 0.0 else hi
+                    lo = self._bounds[idx - 1] if idx > 0 else 0.0
+                    hi = self._bounds[idx]
+                    if lo > 0.0:
+                        rep = (lo * hi) ** 0.5
+                    elif self._bounds is not _HIST_BOUNDS:
+                        # custom grids may span <= 0 where a geometric
+                        # midpoint is undefined — arithmetic midpoint,
+                        # still clamped to the observed range below
+                        rep = (lo + hi) / 2.0
+                    else:
+                        rep = hi
                     return min(max(rep, self._min_ms), self._max_ms)
             return self._max_ms  # unreachable: counts sum to _count
 
     def snapshot(self) -> dict:
         with self._lock:
             count, total = self._count, self._sum_ms
-            observed_max = self._max_ms
+            observed_max = self._max_ms if self._count else 0.0
         mean = total / count if count else 0.0
         # `sum`/`mean` (ms) let exposition compute rates without re-walking
         # buckets; existing keys stay stable (mean_ms == mean, kept for
@@ -199,15 +246,22 @@ class Histogram:
 
     # -- raw state (exposition / cross-process merge) -------------------------
     def state(self) -> dict:
-        """Raw bucket counts + aggregates — the mergeable form. Every
-        Histogram shares the module-level bounds, so merging two states is
-        an elementwise count sum. Exemplars ride along (JSON keys are
-        strings) when any exist; merges keep the newest per bucket."""
+        """Raw bucket counts + aggregates — the mergeable form. Default
+        histograms share the module-level bounds, so merging two states is
+        an elementwise count sum; a custom external grid rides along under
+        `"bounds"` so `from_state` round-trips it exactly (the shared grid
+        is omitted for wire compatibility). The round-trip holds at the
+        edges: an EMPTY histogram exports all-zero counts with
+        `min_ms: None`, and a single observation exports its exact value
+        as both min and max. Exemplars ride along (JSON keys are strings)
+        when any exist; merges keep the newest per bucket."""
         with self._lock:
             out = {"counts": list(self._counts), "count": self._count,
                    "sum_ms": self._sum_ms,
                    "min_ms": self._min_ms if self._count else None,
-                   "max_ms": self._max_ms}
+                   "max_ms": self._max_ms if self._count else 0.0}
+            if self._bounds is not _HIST_BOUNDS:
+                out["bounds"] = list(self._bounds)
             if self._exemplars:
                 out["exemplars"] = {str(i): list(e)
                                     for i, e in self._exemplars.items()}
@@ -215,21 +269,72 @@ class Histogram:
 
     @classmethod
     def from_state(cls, name: str, state: dict) -> "Histogram":
+        bounds = state.get("bounds")
+        h = cls(name, bounds=tuple(bounds) if bounds is not None else None)
         counts = list(state["counts"])
-        if len(counts) != _HIST_BUCKETS:
+        if len(counts) != len(h._counts):
             raise ValueError(
                 f"histogram state has {len(counts)} buckets, expected "
-                f"{_HIST_BUCKETS} (mixed framework versions?)")
-        h = cls(name)
+                f"{len(h._counts)} (mixed framework versions, or a state "
+                f"from a different external grid?)")
         h._counts = [int(c) for c in counts]
         h._count = int(state["count"])
         h._sum_ms = float(state["sum_ms"])
         mn = state.get("min_ms")
         h._min_ms = float("inf") if mn is None else float(mn)
-        h._max_ms = float(state.get("max_ms", 0.0))
+        if h._count:
+            h._max_ms = float(state.get("max_ms", 0.0))
+        # empty: keep the constructor's sentinel so later observations
+        # (negative ones included, on custom grids) still set the max
         for i, e in (state.get("exemplars") or {}).items():
             h._exemplars[int(i)] = tuple(e)
         return h
+
+    def merge_state(self, state: dict) -> "Histogram":
+        """Fold another histogram's `state()` into this one: bucket counts
+        sum elementwise, count/sum add, min/max extend — counts sum, never
+        averaged (the scrape-merge discipline, available per instance so
+        the quality sketches can fold chunk and worker states through ONE
+        kernel). Grids must match exactly; a mismatched grid raises rather
+        than silently mis-binning. Exemplars keep the newest per bucket."""
+        bounds = state.get("bounds")
+        if bounds is not None:
+            if tuple(float(b) for b in bounds) != tuple(self._bounds):
+                raise ValueError(
+                    f"cannot merge histogram states over different bucket "
+                    f"grids ({self.name})")
+        elif self._bounds is not _HIST_BOUNDS:
+            raise ValueError(
+                f"cannot merge a default-grid state into the external-grid "
+                f"histogram {self.name}")
+        counts = state["counts"]
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram state has {len(counts)} buckets, expected "
+                f"{len(self._counts)}")
+        mn = state.get("min_ms")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self._count += int(state["count"])
+            self._sum_ms += float(state["sum_ms"])
+            if mn is not None and float(mn) < self._min_ms:
+                self._min_ms = float(mn)
+            mx = float(state.get("max_ms", 0.0))
+            if int(state["count"]) and mx > self._max_ms:
+                self._max_ms = mx
+            for i, e in (state.get("exemplars") or {}).items():
+                idx = int(i)
+                prev = self._exemplars.get(idx)
+                if prev is None or float(e[2]) >= float(prev[2]):
+                    self._exemplars[idx] = tuple(e)
+        return self
+
+    @property
+    def bounds(self) -> tuple:
+        """This histogram's bucket upper edges (the shared latency grid
+        unless an external grid was passed at construction)."""
+        return tuple(self._bounds)
 
     def __repr__(self):
         return (f"Histogram({self.name}: n={self._count}, "

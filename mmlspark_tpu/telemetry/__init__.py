@@ -41,6 +41,12 @@ Pillars:
 - **Watch** (`telemetry.watch`): threshold + median-shift change-point
   detection over poller series — live regressions trip events and
   flight bundles instead of waiting for the next offline benchdiff.
+- **Quality** (`telemetry.quality`): mergeable streaming distribution
+  sketches on the serving stream, PSI/JS drift against the fit-time
+  reference profile (`quality.drift.*` gauges, `GET /quality`,
+  `scrape_cluster(quality=True)`), and a delayed-label join feeding
+  streaming evaluation through the batch `ComputeModelStatistics`
+  metric kernels — the semantic tier over the systems telemetry.
 - **Hooks**: serving request path, `data.DevicePrefetcher`,
   `TrainingSupervisor` step/checkpoint lifecycle, `fit_booster`
   iterations, `utils.tracing.trace` device profiles (stamped with the
@@ -72,7 +78,16 @@ _LAZY_NAMES = {
     "WindowedCounter": "window", "WindowedHistogram": "window",
     "Objective": "slo", "SLOEngine": "slo", "default_objectives": "slo",
     "merge_verdicts": "slo", "trainer_objectives": "slo",
+    "quality_objectives": "slo",
     "TelemetryPoller": "poller",
+    "QualityMonitor": "quality", "DatasetProfile": "quality",
+    "FeatureSketch": "quality", "StreamingEvaluator": "quality",
+    "get_monitor": "quality", "reset_monitor": "quality",
+    "configure_quality": "quality", "export_quality": "quality",
+    "refresh_quality_gauges": "quality",
+    "merge_quality_exports": "quality", "drift_scores": "quality",
+    "psi": "quality", "js_divergence": "quality",
+    "quality_watch_rules": "quality", "record_label": "quality",
     "StepClock": "goodput", "StragglerDetector": "goodput",
     "flops_from_compile_log": "goodput",
     "ProfileSession": "profiler", "RooflineLedger": "profiler",
@@ -109,8 +124,13 @@ __all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
            "PROM_CONTENT_TYPE", "ExpositionServer", "expose_trainer",
            "WindowedHistogram", "WindowedCounter",
            "Objective", "SLOEngine", "default_objectives", "merge_verdicts",
-           "trainer_objectives",
+           "trainer_objectives", "quality_objectives",
            "TelemetryPoller",
+           "QualityMonitor", "DatasetProfile", "FeatureSketch",
+           "StreamingEvaluator", "get_monitor", "reset_monitor",
+           "configure_quality", "export_quality", "refresh_quality_gauges",
+           "merge_quality_exports", "drift_scores", "psi", "js_divergence",
+           "quality_watch_rules", "record_label",
            "StepClock", "StragglerDetector", "flops_from_compile_log",
            "CompileLog", "FlightRecorder", "AotCache", "collective_traffic",
            "compile_with_analysis",

@@ -206,6 +206,21 @@ def metrics_http_response(path: str, registry=None) -> tuple:
     except ValueError as e:
         return 400, json.dumps({"error": str(e)}).encode(), \
             "application/json"
+    if base in ("/slo", "/metrics", "/metrics.json"):
+        # model-quality gauges refresh right before any read that could
+        # consume them: the drift gauges a /slo quality objective reads
+        # and a /metrics scrape ships must reflect the live sketches,
+        # not the last scrape. Guarded — a broken sketch loses drift
+        # gauges, never the scrape; a process with no quality monitor
+        # pays one None check.
+        try:
+            from .quality import refresh_quality_gauges
+            refresh_quality_gauges(reg)
+        except Exception:  # noqa: BLE001
+            pass
+    if base == "/quality":
+        from .quality import quality_http_response
+        return quality_http_response()
     if base == "/slo":
         from .slo import get_engine
         return 200, json.dumps(get_engine().verdict()).encode(), \
@@ -498,10 +513,14 @@ def state_snapshot(state: dict) -> dict:
 class ClusterSnapshot(NamedTuple):
     """`scrape_cluster` result: the exactly-merged flat snapshot plus each
     worker's raw state for per-host drill-down. `slo` is the fleet-merged
-    `/slo` verdict when the scrape asked for it (None otherwise)."""
+    `/slo` verdict when the scrape asked for it (None otherwise);
+    `quality` is the fleet-merged `/quality` export (sketch counts
+    summed, drift recomputed from the merged counts) when
+    ``quality=True`` was passed."""
     merged: dict
     workers: list   # [(ServiceInfo, raw state dict), ...]
     slo: Optional[dict] = None
+    quality: Optional[dict] = None
 
 
 def scrape_cluster(registry_address: str, name: Optional[str] = None,
@@ -509,7 +528,8 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
                    skip_unreachable: bool = True,
                    window: Optional[float] = None,
                    slo: bool = False,
-                   kind: Optional[str] = None) -> ClusterSnapshot:
+                   kind: Optional[str] = None,
+                   quality: bool = False) -> ClusterSnapshot:
     """Pull `/metrics.json` from every worker the `ServiceRegistry` at
     `registry_address` knows (optionally one service `name`) and merge.
     A worker that died between registering and the scrape is skipped (its
@@ -521,10 +541,14 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
     elementwise; percentiles recompute from the merged windowed buckets).
     `slo=True` also pulls each worker's `/slo` verdict and merges them
     with `telemetry.slo.merge_verdicts` (counts sum, burns recompute).
-    `kind` scrapes only services of that registry kind (``"serving"`` /
-    ``"trainer"``) — no probing; the default merges both, which is
-    well-defined because trainer gauges (goodput) keep max and step
-    histograms bucket-sum exactly like every other metric."""
+    `quality=True` also pulls each worker's `/quality` export and merges
+    them with `telemetry.quality.merge_quality_exports` — live sketch
+    counts sum exactly, fleet drift recomputes from the merged counts
+    (never averaged from per-worker scores). `kind` scrapes only
+    services of that registry kind (``"serving"`` / ``"trainer"``) — no
+    probing; the default merges both, which is well-defined because
+    trainer gauges (goodput) keep max and step histograms bucket-sum
+    exactly like every other metric."""
     from ..io.registry import ServiceInfo, list_services
     if name is not None:
         infos = list_services(registry_address, name, timeout=timeout)
@@ -540,6 +564,7 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
         metrics_path += f"?window={float(window):g}"
     workers = []
     slo_verdicts = []
+    quality_exports = []
     for info in infos:
         try:
             with urllib.request.urlopen(info.address + metrics_path,
@@ -549,6 +574,16 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
                 with urllib.request.urlopen(info.address + "/slo",
                                             timeout=timeout) as resp:
                     slo_verdicts.append(json.loads(resp.read()))
+            if quality:
+                # isolated: a worker without /quality (a pre-quality
+                # version mid-rollout) keeps its metrics and SLO in the
+                # merge — it just contributes no quality export
+                try:
+                    with urllib.request.urlopen(info.address + "/quality",
+                                                timeout=timeout) as resp:
+                        quality_exports.append(json.loads(resp.read()))
+                except (OSError, ValueError):
+                    pass
             workers.append((info, state))
         except (OSError, ValueError) as e:
             if not skip_unreachable:
@@ -563,4 +598,12 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
     if slo:
         from .slo import merge_verdicts
         merged_slo = merge_verdicts(slo_verdicts)
-    return ClusterSnapshot(merged=merged, workers=workers, slo=merged_slo)
+    merged_quality = None
+    if quality:
+        from .quality import merge_quality_exports
+        try:
+            merged_quality = merge_quality_exports(quality_exports)
+        except Exception:  # noqa: BLE001 - the metrics/SLO merge stands
+            merged_quality = None
+    return ClusterSnapshot(merged=merged, workers=workers, slo=merged_slo,
+                           quality=merged_quality)

@@ -73,6 +73,11 @@ TELEMETRY_PROFILE_CAPTURES = "telemetry.profile.captures"
 TELEMETRY_PROFILE_SUPPRESSED = "telemetry.profile.suppressed"
 TELEMETRY_PROFILE_STAMP_ERRORS = "telemetry.profile.stamp_errors"
 TELEMETRY_WATCH_TRIPS = "telemetry.watch.trips"
+QUALITY_LABELS_JOINED = "quality.labels.joined"
+QUALITY_LABELS_LATE = "quality.labels.late"
+QUALITY_LABELS_DUP = "quality.labels.dup"
+QUALITY_LABELS_DROPPED = "quality.labels.dropped"
+QUALITY_SKETCH_ROWS = "quality.sketch.rows"
 
 COUNTERS = {
     SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
@@ -146,6 +151,17 @@ COUNTERS = {
                                     "failed (capture kept, stamp lost)",
     TELEMETRY_WATCH_TRIPS: "telemetry watcher rule trip TRANSITIONS "
                            "(threshold or median-shift)",
+    QUALITY_LABELS_JOINED: "delayed labels joined to their served "
+                           "prediction (streaming evaluation pairs)",
+    QUALITY_LABELS_LATE: "out-of-order labels that arrived BEFORE their "
+                         "prediction and joined late",
+    QUALITY_LABELS_DUP: "duplicate labels for an already-joined request "
+                        "id (counted, not re-joined)",
+    QUALITY_LABELS_DROPPED: "labels lost to the join: prediction aged "
+                            "out of the bounded window, parked-label "
+                            "eviction, or injected label loss",
+    QUALITY_SKETCH_ROWS: "served rows folded into the live quality "
+                         "sketches (head-sampled by request id)",
     "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
                              "(process/thread)",
     "gbdt.hist.route.{route}": "histogram kernel-route selections "
@@ -171,6 +187,7 @@ TRAIN_MFU = "train.mfu"
 TRAIN_LOST_SECONDS = "train.lost_seconds"
 TRAIN_STRAGGLERS = "train.stragglers"
 TELEMETRY_WATCH_TRIPPED = "telemetry.watch.tripped"
+QUALITY_DRIFT_MAX = "quality.drift.max"
 
 GAUGES = {
     GBDT_HIST_PLAN_BYTES: "resident level-invariant one-hot plane bytes "
@@ -197,6 +214,15 @@ GAUGES = {
                       "(windowed step p50 beyond threshold x fleet median)",
     TELEMETRY_WATCH_TRIPPED: "telemetry watcher rules currently in the "
                              "tripped state",
+    QUALITY_DRIFT_MAX: "worst per-column PSI between the frozen "
+                       "reference profile and the live serving sketches "
+                       "(the quality SLO's drift-ceiling input)",
+    "quality.drift.{col}": "per-column PSI drift, reference vs live "
+                           "sketch counts over the shared bucket grid "
+                           "(refreshed on every exposition scrape)",
+    "quality.eval.{metric}": "current streaming-evaluation metric value "
+                             "(accuracy/precision/recall or rmse/mae) "
+                             "from the delayed-label join",
     "device{ordinal}.mem.bytes_in_use": "per-device bytes in use "
                                         "(memory_stats)",
     "device{ordinal}.mem.peak_bytes": "per-device peak bytes in use "
@@ -340,6 +366,9 @@ FAULT_SITES = {
                  "corpus",
     "checkpoint": "corrupt_file default site (checkpoint corruption "
                   "tests)",
+    "quality.label": "StreamingEvaluator.record_label, fired per "
+                     "arriving label (kind `drop` loses the label "
+                     "before the join — counted quality.labels.dropped)",
 }
 
 
@@ -392,3 +421,13 @@ def op_hbm_util(region: str) -> str:
 def op_flops_util(region: str) -> str:
     """op.{region}.flops_util — per-region roofline FLOPs utilization."""
     return f"op.{region}.flops_util"
+
+
+def quality_drift(col: str) -> str:
+    """quality.drift.{col} — per-column PSI drift gauge."""
+    return f"quality.drift.{col}"
+
+
+def quality_eval(metric: str) -> str:
+    """quality.eval.{metric} — streaming-evaluation metric gauge."""
+    return f"quality.eval.{metric}"

@@ -704,6 +704,12 @@ class FlightRecorder:
             # kernel, not just whole-fit ({} until anything was noted)
             from .profiler import roofline_export
             _json("roofline.json", roofline_export())
+            # model-quality state (telemetry/quality.py): per-feature
+            # drift rows + streaming-eval state, so a burning bundle
+            # says whether the fleet is also still PREDICTING well
+            # ({"active": false} on processes without a reference)
+            from .quality import export_quality
+            _json("quality.json", export_quality())
             manifest = {"reason": str(reason), "tag": tag, "seq": seq,
                         "pid": os.getpid(), "t": wall_now(), "path": path,
                         "files": files, "tracer": tracer.stats(),

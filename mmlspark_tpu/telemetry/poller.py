@@ -64,7 +64,7 @@ class TelemetryPoller:
                  kind: Optional[str] = None,
                  jsonl_path: Optional[str] = None,
                  jsonl_max_bytes: int = 16 * 1024 * 1024,
-                 clock=None):
+                 clock=None, quality: bool = False):
         if interval_s <= 0.0:
             raise ValueError("interval_s must be > 0")
         self.registry_address = registry_address
@@ -87,6 +87,11 @@ class TelemetryPoller:
         self.window_s = window_s
         self.timeout = float(timeout)
         self.slo = bool(slo)
+        # quality=True also pulls each worker's /quality export and keeps
+        # the fleet-merged result on the sample (sketch counts sum,
+        # drift recomputed — telemetry/quality.py); the flat
+        # quality.drift.* gauges ride the merged metrics either way
+        self.quality = bool(quality)
         # fleet-side flight trigger: when the MERGED verdict transitions
         # to burning, dump a local debug bundle (telemetry/perf.py) — the
         # poller is the one process that sees the fleet burn even when no
@@ -131,12 +136,15 @@ class TelemetryPoller:
         see the error."""
         snap = scrape_cluster(self.registry_address, name=self.name,
                               timeout=self.timeout, window=self.window_s,
-                              slo=self.slo, kind=self.kind)
+                              slo=self.slo, kind=self.kind,
+                              quality=self.quality)
         sample = {"t": self._clock(),
                   "workers": snap.merged.get("telemetry.scrape.workers", 0),
                   "window_s": snap.merged.get("telemetry.scrape.window_s"),
                   "metrics": snap.merged,
                   "slo": snap.slo}
+        if self.quality:
+            sample["quality"] = snap.quality
         with self._lock:
             self._samples.append(sample)
         reliability_metrics.inc(tnames.TELEMETRY_POLL_SAMPLES)

@@ -45,17 +45,21 @@ from . import names as tnames
 LATENCY = "latency"
 ERROR_RATE = "error_rate"
 GOODPUT = "goodput"
+QUALITY = "quality"
 
 
 class Objective(NamedTuple):
     """One declared objective. `kind` is `latency` (histogram `metric`,
     `quantile` of requests must finish under `threshold_ms`),
     `error_rate` (counter `metric` over counter `total_metric` must stay
-    under `budget`), or `goodput` (gauge `metric` must stay at or above
+    under `budget`), `goodput` (gauge `metric` must stay at or above
     `floor` — the training-side floor on productive wall-clock
-    fraction). `window_s` is the short evaluation window; a gauge
-    objective reads the same last-set value in both windows (gauges
-    carry no shards — the StepClock already windows its own inputs)."""
+    fraction), or `quality` (a model-quality gauge from
+    telemetry/quality.py: a drift gauge bounded above by `ceiling`, or a
+    streaming-eval metric bounded below by `floor`). `window_s` is the
+    short evaluation window; a gauge objective reads the same last-set
+    value in both windows (gauges carry no shards — the StepClock /
+    quality sketches already window their own inputs)."""
     name: str
     kind: str
     metric: str
@@ -64,7 +68,8 @@ class Objective(NamedTuple):
     quantile: float = 99.0         # latency only
     budget: float = 0.01           # error_rate only
     total_metric: str = ""         # error_rate only
-    floor: float = 0.0             # goodput only
+    floor: float = 0.0             # goodput / quality metric floor
+    ceiling: float = 0.0           # quality drift bound (value must stay <=)
 
 
 def default_objectives() -> list:
@@ -93,6 +98,27 @@ def trainer_objectives(goodput_floor: float = 0.9,
                   metric=tnames.TRAIN_GOODPUT, floor=goodput_floor,
                   window_s=window_s),
     ]
+
+
+def quality_objectives(drift_ceiling: float = 0.25,
+                       metric_floor: Optional[float] = None,
+                       metric: str = "quality.eval.accuracy",
+                       window_s: float = 60.0) -> list:
+    """The model-quality objectives (telemetry/quality.py): the worst
+    per-column PSI (`quality.drift.max`, refreshed on every scrape) must
+    stay at or below `drift_ceiling` — 0.25 is the classic
+    "distribution shifted" PSI bound — and, with `metric_floor` set, the
+    streaming-eval gauge `metric` must stay at or above it. Ceiling
+    objectives merge on the WORST (max) worker, floor objectives on the
+    worst (min) — never averaged, like goodput."""
+    out = [Objective(name="quality.drift", kind=QUALITY,
+                     metric=tnames.QUALITY_DRIFT_MAX,
+                     ceiling=drift_ceiling, window_s=window_s)]
+    if metric_floor is not None:
+        out.append(Objective(name="quality.metric.floor", kind=QUALITY,
+                             metric=metric, floor=metric_floor,
+                             window_s=window_s))
+    return out
 
 
 def _violations_over(counts: list, threshold_ms: float) -> int:
@@ -174,7 +200,7 @@ class SLOEngine:
             for w in (obj.window_s, obj.window_s * self.long_factor):
                 if obj.kind == LATENCY:
                     m = self._latency_window(obj, w)
-                elif obj.kind == GOODPUT:
+                elif obj.kind in (GOODPUT, QUALITY):
                     m = self._gauge_window(obj, w)
                 else:
                     m = self._error_window(obj, w)
@@ -203,16 +229,24 @@ def _finish_window(obj: dict, m: dict) -> dict:
     """Rate/burn math for one window measurement — shared by the live
     engine and the fleet merge so both always agree."""
     m = dict(m)
-    if obj["kind"] == GOODPUT:
-        # burn > 1 exactly when the gauge sits below the floor; no data
-        # (never trained) burns 0 — absence of evidence is not a burn
+    if obj["kind"] in (GOODPUT, QUALITY):
+        # gauge objectives: burn > 1 exactly when the gauge crosses its
+        # bound — below the floor (goodput, a metric floor) or above the
+        # ceiling (a drift bound). No data (never trained / no live
+        # traffic folded) burns 0 — absence of evidence is not a burn
         value = m.get("value")
         floor = obj.get("floor", 0.0)
+        ceiling = obj.get("ceiling", 0.0)
         if value is None:
             m["rate"], m["burn_rate"] = 0.0, 0.0
         else:
             m["rate"] = value
-            m["burn_rate"] = floor / max(value, 1e-9) if floor > 0 else 0.0
+            if ceiling > 0:
+                m["burn_rate"] = value / ceiling
+            elif floor > 0:
+                m["burn_rate"] = floor / max(value, 1e-9)
+            else:
+                m["burn_rate"] = 0.0
         return m
     if obj["kind"] == LATENCY:
         count, violations = m.get("count", 0), m.get("violations", 0)
@@ -261,9 +295,12 @@ def merge_verdicts(verdicts: list) -> Optional[dict]:
                     wa["value_ms_max"] = max(wa.get("value_ms_max", 0.0),
                                              wb["value_ms"])
                 if "value" in wb:
-                    # gauge objectives (goodput floor): the WORST worker
-                    # is the fleet verdict — min, never averaged
-                    wa["value"] = (min(wa["value"], wb["value"])
+                    # gauge objectives: the WORST worker is the fleet
+                    # verdict — min for a floor (goodput, metric floor),
+                    # MAX for a ceiling (drift bound) — never averaged
+                    pick = (max if agg["objective"].get("ceiling", 0.0) > 0
+                            else min)
+                    wa["value"] = (pick(wa["value"], wb["value"])
                                    if "value" in wa else wb["value"])
                     wa.pop("no_data", None)
     objectives = []

@@ -4,6 +4,12 @@
 Consume a scored Table (label + scores/probabilities/prediction columns) and
 emit a one-row metrics Table (plus confusion matrix accessor) or per-row
 statistics columns.
+
+The metric math lives in `train.metrics`' mergeable state cores
+(`ConfusionState`/`RegressionState`); the streaming evaluator on the
+serving stream (`telemetry.quality.StreamingEvaluator`) folds the SAME
+states, so this batch transformer and online evaluation share one
+finalize kernel by construction (parity pinned in tests/test_quality.py).
 """
 from __future__ import annotations
 

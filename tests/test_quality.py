@@ -1,0 +1,834 @@
+"""Model-quality observability (ISSUE 12): streaming sketches on the
+serving stream, drift telemetry, the delayed-label join, and quality
+SLOs.
+
+Pins the new contracts: sketch folds and fleet merges are EXACT (counts
+sum, Welford combine — never averaged; chunked == whole == fleet);
+`Histogram.state()/from_state()` round-trips externally-built bucket
+grids including the empty and single-observation edges; streaming
+evaluation over chunks equals batch `ComputeModelStatistics` over the
+concatenation (one metric kernel); the label join counts out-of-order /
+duplicate / after-eviction labels instead of crashing, under a seeded
+FaultInjector schedule; `GET /quality` answers on both serving
+transports, the registry, and the trainer surface;
+`scrape_cluster(quality=True)` merges two live workers exactly; and the
+seeded end-to-end acceptance: an injected feature shift on the serving
+stream moves `quality.drift.{col}`, trips a watch rule, flips the
+quality SLO to burning, and the flight bundle carries quality.json —
+events causally ordered."""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.core import Table
+from mmlspark_tpu.reliability.faults import FaultInjector
+from mmlspark_tpu.reliability.metrics import (Histogram, MetricsRegistry,
+                                              reliability_metrics)
+from mmlspark_tpu.telemetry import names as tnames
+from mmlspark_tpu.telemetry import perf
+from mmlspark_tpu.telemetry import quality as Q
+from mmlspark_tpu.telemetry import slo as tslo
+from mmlspark_tpu.train import metrics as tmetrics
+
+
+@pytest.fixture
+def quality_state():
+    """Fresh process monitor + clean registry; restore after."""
+    reliability_metrics.reset()
+    monitor = Q.reset_monitor()
+    yield monitor
+    Q.reset_monitor()
+    reliability_metrics.reset()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=15)
+    return resp, json.loads(resp.read())
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=15) as resp:
+        return json.loads(resp.read())
+
+
+def _fit_model(n=800, f=5, iters=5, **kw):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
+    model = GBDTClassifier(num_iterations=iters, max_depth=3, **kw).fit(
+        Table({"features": x, "label": y}))
+    return model, x, y
+
+
+# ------------------------------------------------- histogram external grids
+def test_histogram_external_grid_roundtrip_edges():
+    """The satellite fix: state()/from_state() is exact for
+    externally-built grids at the empty and single-observation edges,
+    and signed values stay unclamped (the latency clamp is default-grid
+    only)."""
+    empty = Histogram("q", bounds=(-1.0, 0.0, 2.5))
+    st = empty.state()
+    assert st["counts"] == [0, 0, 0, 0] and st["min_ms"] is None
+    assert st["bounds"] == [-1.0, 0.0, 2.5]
+    assert Histogram.from_state("q", st).state() == st
+
+    one = Histogram("q1", bounds=(-1.0, 0.0, 2.5))
+    one.observe_ms(-0.5)
+    st1 = one.state()
+    assert st1["counts"] == [0, 1, 0, 0]
+    assert st1["min_ms"] == st1["max_ms"] == -0.5 and st1["sum_ms"] == -0.5
+    rt = Histogram.from_state("q1", st1)
+    assert rt.state() == st1
+    # a round-tripped EMPTY grid still tracks a later negative max
+    again = Histogram.from_state("q", st)
+    again.observe_ms(-0.9)
+    assert again.state()["max_ms"] == -0.9
+
+    # the default latency grid still clamps negatives and omits bounds
+    lat = Histogram("lat")
+    lat.observe_ms(-3.0)
+    assert "bounds" not in lat.state()
+    assert lat.state()["min_ms"] == 0.0
+
+
+def test_histogram_merge_state_counts_sum_never_average():
+    a = Histogram("a", bounds=(0.0, 1.0, 2.0))
+    b = Histogram("b", bounds=(0.0, 1.0, 2.0))
+    for v in (-0.5, 0.5, 1.5, 99.0):
+        a.observe_ms(v)
+    b.observe_ms(0.5)
+    merged = Histogram("m", bounds=(0.0, 1.0, 2.0))
+    merged.merge_state(a.state())
+    merged.merge_state(b.state())
+    assert merged.state()["counts"] == [1, 2, 1, 1]
+    assert merged.count == 5
+    assert merged.state()["min_ms"] == -0.5
+    assert merged.state()["max_ms"] == 99.0
+    # grid mismatch must raise, never mis-bin
+    with pytest.raises(ValueError):
+        merged.merge_state(Histogram("x", bounds=(0.0, 9.0)).state())
+    with pytest.raises(ValueError):
+        merged.merge_state(Histogram("lat").state())
+
+
+# ---------------------------------------------------------------- sketches
+def test_moments_chunked_merge_matches_whole():
+    rng = np.random.default_rng(3)
+    v = rng.normal(loc=2.0, scale=3.0, size=4096)
+    whole = Q._Moments().update(v)
+    chunked = Q._Moments()
+    for lo in range(0, v.size, 511):
+        chunked.merge(Q._Moments().update(v[lo:lo + 511]))
+    assert chunked.n == whole.n == v.size
+    assert abs(chunked.mean - whole.mean) < 1e-12
+    assert abs(chunked.m2 - whole.m2) < 1e-6 * abs(whole.m2)
+
+
+def test_feature_sketch_chunk_fold_equals_whole_fold():
+    """Counts sum exactly: folding chunks == folding the concatenation ==
+    merging two sketches (the fleet-merge contract at sketch level)."""
+    rng = np.random.default_rng(4)
+    v = rng.normal(size=3000)
+    ref = Q.build_numeric_sketch("f0", v[:1000])
+    whole = ref.spawn_empty()
+    whole.observe(v)
+    chunked = ref.spawn_empty()
+    for lo in range(0, v.size, 173):
+        chunked.observe(v[lo:lo + 173])
+    a, b = ref.spawn_empty(), ref.spawn_empty()
+    a.observe(v[:1700])
+    b.observe(v[1700:])
+    a.merge(b)
+    wc = whole.state()["hist"]["counts"]
+    assert chunked.state()["hist"]["counts"] == wc
+    assert a.state()["hist"]["counts"] == wc
+    assert whole.count == chunked.count == a.count == v.size
+    # moments agree too
+    mw, ma = whole.state()["moments"], a.state()["moments"]
+    assert mw["n"] == ma["n"]
+    assert abs(mw["mean"] - ma["mean"]) < 1e-12
+
+
+def test_categorical_topk_bounded_and_merge():
+    sk = Q.FeatureSketch("cat", Q.CATEGORICAL, topk=3)
+    sk.observe(np.array([1, 1, 1, 2, 2, 3, 4, 4, 4, 4]))
+    st = sk.state()
+    assert len(st["counts"]) <= 3
+    assert st["total"] == 10
+    assert st["counts"]["4"] >= 4 and st["counts"]["1"] == 3
+    other = Q.FeatureSketch("cat", Q.CATEGORICAL, topk=3)
+    other.observe(np.array([1, 1, 5]))
+    sk.merge(other)
+    assert sk.total == 13
+    assert len(sk.counts) <= 3
+    assert sk.counts["1"] == 5
+    # round-trip
+    assert Q.FeatureSketch.from_state(sk.state()).state() == sk.state()
+
+
+def test_psi_js_math():
+    same = np.array([10.0, 20.0, 30.0, 40.0])
+    # scale-invariant up to the Laplace pseudo-count
+    assert Q.psi(same, same * 7) < 0.01
+    assert Q.js_divergence(same, same * 7) < 0.01
+    shifted = np.array([40.0, 30.0, 20.0, 10.0])
+    p = Q.psi(same, shifted)
+    assert p > 0.25
+    js = Q.js_divergence(same, shifted)
+    assert 0.0 < js <= 1.0
+    assert abs(Q.js_divergence(shifted, same) - js) < 1e-12  # symmetric
+    # disjoint distributions: js saturates near 1
+    assert Q.js_divergence([1000.0, 0.0], [0.0, 1000.0]) > 0.97
+    # small-sample sanity (the Laplace point): 30 in-distribution rows
+    # over 10 buckets must NOT read as shifted
+    rng = np.random.default_rng(2)
+    ref = np.full(10, 500.0)
+    live = np.bincount(rng.integers(0, 10, size=30), minlength=10)
+    assert Q.psi(ref, live) < 0.25
+
+
+def test_dataset_profile_fit_spawn_roundtrip_and_drift():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4000, 3))
+    cols = Q.matrix_columns(x)
+    cols["cat"] = rng.integers(0, 4, size=4000)
+    prof = Q.DatasetProfile.fit(cols, categorical=("cat",))
+    st = prof.state()
+    json.dumps(st)   # JSON-safe by construction
+    assert Q.DatasetProfile.from_state(st).state() == st
+    live = prof.spawn_live()
+    assert live.count == 0
+    assert tuple(live.columns["f0"].edges) == tuple(prof.columns["f0"].edges)
+    live.observe("f0", rng.normal(size=2000))             # in-distribution
+    live.observe("f1", rng.normal(loc=4.0, size=2000))    # shifted
+    live.observe("cat", np.full(500, 9))                  # unseen category
+    rows = Q.drift_scores(prof, live)
+    assert rows["f1"]["psi"] > 0.25 > rows["f0"]["psi"] >= 0.0
+    assert rows["cat"]["psi"] > 0.25
+    assert rows["f2"]["psi"] is None     # no live traffic: no claim
+    # grid mismatch is labeled, not silently scored
+    other = Q.DatasetProfile.fit({"f0": rng.normal(loc=50.0, size=500)})
+    mismatch = Q.drift_scores(prof, other)
+    assert mismatch["f0"].get("grid_mismatch") is True
+
+
+def test_profile_columns_chunked_equals_whole_and_fleet_merge():
+    """The data-layer tap: data.pipeline.profile_columns folds chunks
+    through the same exact merge a fleet scrape uses — chunked == whole
+    == merged-across-workers."""
+    from mmlspark_tpu.data import profile_columns
+    rng = np.random.default_rng(6)
+    cols = {"f0": rng.normal(size=2500), "f1": rng.uniform(size=2500)}
+    grids = Q.DatasetProfile.fit(cols, observe=False)
+    whole = grids.spawn_live()
+    for name in ("f0", "f1"):
+        whole.observe(name, cols[name])
+    chunked = grids.spawn_live()
+    profile_columns(chunked, cols, chunk_rows=321)
+    # fleet merge: two "workers" each fold half, merged == whole
+    w1, w2 = grids.spawn_live(), grids.spawn_live()
+    profile_columns(w1, {k: v[:1250] for k, v in cols.items()})
+    profile_columns(w2, {k: v[1250:] for k, v in cols.items()})
+    w1.merge(w2.state())
+    for prof in (chunked, w1):
+        for name in ("f0", "f1"):
+            got = prof.columns[name].state()
+            want = whole.columns[name].state()
+            # counts are integer-EXACT under any chunking/merging;
+            # moments are Chan-exact up to float association
+            assert got["hist"]["counts"] == want["hist"]["counts"]
+            assert got["hist"]["count"] == want["hist"]["count"] == 2500
+            assert got["edges"] == want["edges"]
+            assert got["moments"]["n"] == want["moments"]["n"]
+            np.testing.assert_allclose(got["moments"]["mean"],
+                                       want["moments"]["mean"], rtol=1e-12)
+            np.testing.assert_allclose(got["moments"]["m2"],
+                                       want["moments"]["m2"], rtol=1e-9)
+
+
+# --------------------------------------------------------- metrics core
+def test_confusion_state_chunk_merge_equals_batch():
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 3, size=900)
+    p = rng.integers(0, 3, size=900)
+    batch_vals, batch_cm = tmetrics.multiclass_metrics(y, p)
+    st = tmetrics.ConfusionState(2)
+    for lo in range(0, 900, 111):
+        st.update(y[lo:lo + 111], p[lo:lo + 111])
+    assert np.array_equal(st.cm, batch_cm)           # integer-exact
+    stream_vals = st.metrics()
+    assert np.isnan(stream_vals.pop("AUC")) and np.isnan(
+        batch_vals.pop("AUC"))   # rank metrics stay batch-only
+    assert stream_vals == batch_vals
+    # merge of two states == one state over the concatenation
+    a = tmetrics.ConfusionState.from_arrays(y[:400], p[:400])
+    b = tmetrics.ConfusionState.from_arrays(y[400:], p[400:])
+    assert np.array_equal(a.merge(b).cm, batch_cm)
+    # state round-trip
+    rt = tmetrics.ConfusionState.from_state(a.state())
+    assert np.array_equal(rt.cm, a.cm)
+
+
+def test_regression_state_chunk_merge_equals_batch():
+    rng = np.random.default_rng(8)
+    y = rng.normal(size=1000)
+    p = y + rng.normal(scale=0.1, size=1000)
+    batch = tmetrics.regression_metrics(y, p)
+    st = tmetrics.RegressionState()
+    for lo in range(0, 1000, 137):
+        st.update(y[lo:lo + 137], p[lo:lo + 137])
+    stream = st.metrics()
+    for key in ("mse", "rmse", "r2", "mae"):
+        np.testing.assert_allclose(stream[key], batch[key], rtol=1e-9)
+    merged = tmetrics.RegressionState.from_arrays(y[:500], p[:500]).merge(
+        tmetrics.RegressionState.from_arrays(y[500:], p[500:]))
+    np.testing.assert_allclose(merged.metrics()["rmse"], batch["rmse"],
+                               rtol=1e-9)
+
+
+def test_streaming_evaluator_parity_with_compute_model_statistics(
+        quality_state):
+    """The tentpole parity pin: the streaming evaluator fed per-chunk ==
+    batch ComputeModelStatistics over the concatenation, on the shared
+    (threshold-side) metrics — ONE finalize kernel underneath both."""
+    from mmlspark_tpu.train import ComputeModelStatistics
+    rng = np.random.default_rng(9)
+    y = rng.integers(0, 2, size=600).astype(np.float64)
+    pred = np.where(rng.uniform(size=600) < 0.8, y, 1 - y)
+    ev = Q.StreamingEvaluator(registry=MetricsRegistry(window_shards=0))
+    for i in range(600):
+        ev.record_prediction(f"r{i}", pred[i])
+        ev.record_label(f"r{i}", y[i])
+    stats = ComputeModelStatistics(evaluation_metric="classification") \
+        .transform(Table({"label": y, "prediction": pred}))
+    streaming = ev.metrics()
+    np.testing.assert_allclose(streaming["accuracy"],
+                               float(np.asarray(stats["accuracy"])[0]),
+                               rtol=1e-12)
+    # threshold-side binary kernel parity (batch binary_metrics and the
+    # evaluator literally share ConfusionState.binary())
+    batch_vals, batch_cm = tmetrics.binary_metrics(y, pred, y_pred=pred)
+    for key in ("accuracy", "precision", "recall"):
+        np.testing.assert_allclose(streaming[key], batch_vals[key],
+                                   rtol=1e-12)
+    assert np.array_equal(
+        np.asarray(ev.export()["confusion"]["cm"]), batch_cm)
+    # and the merged two-worker split agrees exactly too
+    half1 = Q.StreamingEvaluator(registry=MetricsRegistry(window_shards=0))
+    half2 = Q.StreamingEvaluator(registry=MetricsRegistry(window_shards=0))
+    for i in range(600):
+        target = half1 if i % 2 == 0 else half2
+        target.record_prediction(f"r{i}", pred[i])
+        target.record_label(f"r{i}", y[i])
+    merged = Q.StreamingEvaluator(registry=MetricsRegistry(window_shards=0))
+    merged.merge_export(half1.export())
+    merged.merge_export(half2.export())
+    assert merged.export()["confusion"] == ev.export()["confusion"]
+
+
+def test_streaming_evaluator_regression_kind_auto(quality_state):
+    reg = MetricsRegistry(window_shards=0)
+    ev = Q.StreamingEvaluator(registry=reg)
+    ev.record_prediction("a", 1.37)
+    ev.record_label("a", 1.5)
+    ex = ev.export()
+    assert ex["kind"] == "regression"
+    np.testing.assert_allclose(ex["metrics"]["mae"], 0.13, rtol=1e-9)
+    assert reg.peek_gauge(tnames.quality_eval("rmse")) is not None
+
+
+# ------------------------------------------------------- label-join chaos
+def test_label_join_anomalies_counted_not_crashed(quality_state):
+    reg = MetricsRegistry(window_shards=0)
+    ev = Q.StreamingEvaluator(registry=reg, max_pending=3, max_parked=2)
+    # normal join
+    ev.record_prediction("a", 1.0)
+    assert ev.record_label("a", 1.0) == "joined"
+    # out-of-order: label first, joins late when the prediction arrives
+    assert ev.record_label("b", 0.0) == "parked"
+    assert ev.record_prediction("b", 0.0) == "late-join"
+    # duplicate
+    assert ev.record_label("a", 1.0) == "dup"
+    # label-after-eviction: the window holds 3, p0 ages out
+    for i in range(5):
+        ev.record_prediction(f"p{i}", 1.0)
+    assert ev.record_label("p0", 1.0) == "dropped"
+    # parked-slot eviction drops the oldest parked label
+    ev.record_label("x1", 1.0)
+    ev.record_label("x2", 1.0)
+    ev.record_label("x3", 1.0)   # evicts x1's parked slot
+    assert reg.get(tnames.QUALITY_LABELS_JOINED) == 2
+    assert reg.get(tnames.QUALITY_LABELS_LATE) == 1
+    assert reg.get(tnames.QUALITY_LABELS_DUP) == 1
+    assert reg.get(tnames.QUALITY_LABELS_DROPPED) == 2
+    # evaluation state stayed consistent through all of it
+    assert ev.export()["joined"] == 2
+
+
+def test_merge_quality_exports_skips_incompatible_worker(quality_state):
+    """A mid-rollout worker whose sketch grids differ (retrained model)
+    is skipped and counted — never allowed to kill the fleet merge or
+    leave a partial fold behind."""
+    rng = np.random.default_rng(15)
+    ref_a = Q.DatasetProfile.fit({"f0": rng.normal(size=500)})
+    ref_b = Q.DatasetProfile.fit({"f0": rng.normal(loc=30.0, size=500)})
+
+    def export_for(ref):
+        mon = Q.QualityMonitor(registry=MetricsRegistry(window_shards=0))
+        mon.set_reference(ref)
+        mon.observe_serving({"f0": rng.normal(size=100)},
+                            np.zeros(100), None)
+        return mon.export()
+
+    a1, a2, b = export_for(ref_a), export_for(ref_a), export_for(ref_b)
+    merged = Q.merge_quality_exports([a1, b, a2])
+    assert merged["workers"] == 2 and merged["workers_skipped"] == 1
+    # the two compatible workers merged EXACTLY, untouched by the skip
+    assert merged["live"]["columns"]["f0"]["hist"]["count"] == 200
+
+
+def test_confusion_explicit_n_classes_rejects_stray_labels():
+    """An explicit class count is a contract: a stray out-of-range label
+    raises (the pre-state kernel's behavior) instead of silently growing
+    the matrix under metrics that only read the k x k corner."""
+    with pytest.raises(IndexError):
+        tmetrics.confusion_matrix([0, 1, 2], [0, 1, 1], n_classes=2)
+    with pytest.raises(IndexError):
+        tmetrics.binary_metrics(np.array([0, 1, 2]),
+                                np.array([0.1, 0.9, 0.8]))
+    # auto-sized stays permissive (streaming growth semantics)
+    assert tmetrics.confusion_matrix([0, 2], [1, 2]).shape == (3, 3)
+
+
+def test_profile_fit_grid_only_leaves_sketches_empty():
+    """observe=False freezes grids WITHOUT folding the sample (the
+    chunked ingest tap folds it exactly once itself)."""
+    rng = np.random.default_rng(16)
+    prof = Q.DatasetProfile.fit({"f0": rng.normal(size=1000)},
+                                observe=False)
+    sk = prof.columns["f0"]
+    assert sk.count == 0
+    assert len(sk.edges) >= 2   # grid still frozen from the sample
+
+
+def test_hostile_labels_counted_not_crashed(quality_state):
+    """A non-finite, out-of-range, or unparsable label is DROPPED, never
+    folded: one label of 1e9 must not allocate a billion-class confusion
+    matrix, and -1 must not wrap a negative index into it."""
+    reg = MetricsRegistry(window_shards=0)
+    ev = Q.StreamingEvaluator(registry=reg)
+    for i in range(4):
+        ev.record_prediction(f"h{i}", 1.0)
+    assert ev.record_label("h0", 1.0) == "joined"      # resolves kind
+    assert ev.record_label("h1", 1e9) == "dropped"
+    assert ev.record_label("h2", -1.0) == "dropped"
+    assert ev.record_label("h3", float("nan")) == "dropped"
+    assert ev.record_label("h3", "cat") == "dropped"
+    assert reg.get(tnames.QUALITY_LABELS_DROPPED) == 4
+    ex = ev.export()
+    assert ex["joined"] == 1
+    assert np.asarray(ex["confusion"]["cm"]).shape == (2, 2)
+
+
+def test_regression_state_large_offset_r2_stable(quality_state):
+    """The Welford label moments keep r2 correct where raw
+    sum(y)/sum(y^2) cancellation would destroy it (y ~ 1e8 ± 1)."""
+    rng = np.random.default_rng(13)
+    y = 1e8 + rng.normal(size=2000)
+    p = y + rng.normal(scale=0.1, size=2000)
+    batch = tmetrics.regression_metrics(y, p)
+    assert 0.98 < batch["r2"] <= 1.0
+    st = tmetrics.RegressionState()
+    for lo in range(0, 2000, 333):
+        st.update(y[lo:lo + 333], p[lo:lo + 333])
+    np.testing.assert_allclose(st.metrics()["r2"], batch["r2"], rtol=1e-6)
+
+
+@pytest.mark.chaos
+def test_label_join_chaos_seeded_fault_schedule(quality_state):
+    """Seeded label-loss chaos: a FaultInjector schedule on the
+    `quality.label` site drops exact labels; counts are deterministic and
+    two same-seed runs produce identical fault histories."""
+    def run(seed):
+        reg = MetricsRegistry(window_shards=0)
+        inj = FaultInjector(seed=seed, rules=[
+            {"site": "quality.label", "kind": "drop", "at": [1, 4]}])
+        ev = Q.StreamingEvaluator(registry=reg, faults=inj)
+        for i in range(6):
+            ev.record_prediction(f"r{i}", float(i % 2))
+        results = [ev.record_label(f"r{i}", float(i % 2))
+                   for i in range(6)]
+        return results, inj.schedule(), reg
+
+    results, sched, reg = run(21)
+    assert results[1] == results[4] == "dropped"
+    assert [r for i, r in enumerate(results) if i not in (1, 4)] \
+        == ["joined"] * 4
+    assert reg.get(tnames.QUALITY_LABELS_DROPPED) == 2
+    assert reg.get(tnames.QUALITY_LABELS_JOINED) == 4
+    # seed-reproducibility: identical schedule on a second run
+    results2, sched2, _ = run(21)
+    assert results2 == results and sched2 == sched
+    assert sched == [("quality.label", 1, "drop"),
+                     ("quality.label", 4, "drop")]
+
+
+# ----------------------------------------------------------- monitor + tap
+def test_monitor_sampling_deterministic_by_request_id(quality_state):
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(512, 2)).astype(np.float32)
+    ref = Q.DatasetProfile.fit(Q.matrix_columns(x))
+    ids = [f"req-{i}" for i in range(256)]
+
+    def fold(sample):
+        mon = Q.QualityMonitor(registry=MetricsRegistry(window_shards=0))
+        mon.set_reference(ref)
+        mon.configure(sample=sample, labels=False)
+        mon.observe_serving(x[:256], np.zeros(256), ids)
+        return mon.live.columns["f0"].count
+
+    full = fold(1.0)
+    assert full == 256
+    sampled_a, sampled_b = fold(0.25), fold(0.25)
+    assert sampled_a == sampled_b            # crc32(id): deterministic
+    assert 0 < sampled_a < 256
+    assert fold(0.0) == 0
+
+    # id-less callers still honor the rate (systematic sampling): an
+    # id-less transport must not silently fold 100% of traffic
+    mon = Q.QualityMonitor(registry=MetricsRegistry(window_shards=0))
+    mon.set_reference(ref)
+    mon.configure(sample=0.25, labels=False)
+    for lo in range(0, 256, 32):
+        mon.observe_serving(x[lo:lo + 32], np.zeros(32), None)
+    assert mon.live.columns["f0"].count == 64   # exactly every 4th row
+
+
+def test_stale_drift_gauges_cleared_on_reference_swap(quality_state):
+    """A new model's set_reference (and every refresh) republishes the
+    drift gauges from a clean slate — the old model's drift must not
+    keep an SLO burning against a model no longer served."""
+    rng = np.random.default_rng(14)
+    ref = Q.DatasetProfile.fit({"f0": rng.normal(size=2000)})
+    mon = Q.get_monitor()
+    mon.set_reference(ref)
+    mon.configure(sample=1.0, labels=False, min_live=32)
+    mon.observe_serving({"f0": rng.normal(loc=6.0, size=200)},
+                        np.zeros(200), None)
+    mon.refresh_gauges()
+    assert reliability_metrics.gauge(tnames.QUALITY_DRIFT_MAX) > 0.25
+    # deploy "model B": same grids, fresh live profile
+    mon.set_reference(ref)
+    assert reliability_metrics.peek_gauge(tnames.QUALITY_DRIFT_MAX) is None
+    assert reliability_metrics.peek_gauge(
+        tnames.quality_drift("f0")) is None
+    # a refresh below min_live publishes nothing — still no stale gauge
+    mon.refresh_gauges()
+    assert reliability_metrics.peek_gauge(tnames.QUALITY_DRIFT_MAX) is None
+
+
+def test_gbdt_fit_attaches_reference_profile(quality_state):
+    model, x, y = _fit_model()
+    qp = model.quality_profile
+    assert sorted(qp["columns"])[:3] == ["f0", "f1", "f2"]
+    assert "label" in qp["columns"] and "prediction" in qp["columns"]
+    assert qp["columns"]["f0"]["hist"]["count"] == x.shape[0]
+    # opt-out leaves no profile behind
+    from mmlspark_tpu.models.gbdt.estimators import GBDTRegressor
+    m2 = GBDTRegressor(num_iterations=3, max_depth=3,
+                       quality_profile=False).fit(
+        Table({"features": x, "label": y.astype(np.float32)}))
+    assert getattr(m2, "quality_profile", None) is None
+
+
+def test_serving_tap_live_sketches_and_label_join(quality_state):
+    """The serving hot path feeds the live sketches + the delayed-label
+    join keyed on X-Request-Id; /quality answers on the selector
+    transport; drift gauges publish only past the min_live floor."""
+    from mmlspark_tpu.io.serving import serve_pipeline
+    model, x, y = _fit_model()
+    server, q = serve_pipeline(model, input_cols=["features"],
+                               mode="continuous")
+    try:
+        mon = Q.get_monitor()
+        assert mon.active, "ServingTransform did not install the profile"
+        mon.configure(sample=1.0, min_live=8)
+        rids = []
+        for i in range(16):
+            resp, body = _post(server.address,
+                               {"features": [float(v) for v in x[i]]})
+            rids.append(resp.headers["X-Request-Id"])
+            assert "prediction" in body
+        assert mon.live.columns["f0"].count == 16
+        assert reliability_metrics.get(tnames.QUALITY_SKETCH_ROWS) == 16
+        for i, rid in enumerate(rids):
+            assert Q.record_label(rid, float(y[i])) == "joined"
+        assert reliability_metrics.get(tnames.QUALITY_LABELS_JOINED) == 16
+        payload = _get_json(server.address + "/quality")
+        assert payload["active"] is True
+        assert payload["eval"]["joined"] == 16
+        assert payload["live"]["columns"]["f0"]["hist"]["count"] == 16
+        assert payload["drift"]["f0"]["psi"] is not None
+        # a /metrics scrape refreshes the drift gauges (min_live met)
+        urllib.request.urlopen(server.address + "/metrics",
+                               timeout=15).read()
+        assert reliability_metrics.peek_gauge(
+            tnames.QUALITY_DRIFT_MAX) is not None
+        assert reliability_metrics.peek_gauge(
+            tnames.quality_drift("f0")) is not None
+        # below the floor nothing publishes: fresh monitor, high floor
+        mon.configure(min_live=10_000)
+        reliability_metrics.reset("quality.drift")
+        urllib.request.urlopen(server.address + "/metrics",
+                               timeout=15).read()
+        assert reliability_metrics.peek_gauge(
+            tnames.QUALITY_DRIFT_MAX) is None
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_quality_endpoint_threading_registry_and_trainer(quality_state):
+    """GET /quality rides EXPOSITION_PATHS everywhere: the threading
+    serving transport, the ServiceRegistry, and the trainer
+    ExpositionServer."""
+    from mmlspark_tpu.io.registry import ServiceRegistry
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+    from mmlspark_tpu.telemetry.exposition import ExpositionServer
+    rng = np.random.default_rng(11)
+    ref = Q.DatasetProfile.fit({"f0": rng.normal(size=500)})
+    Q.get_monitor().set_reference(ref)
+
+    server = ServingServer(num_partitions=1, transport="threading").start()
+    query = ServingQuery(server, lambda bodies: [{"ok": 1}] * len(bodies),
+                         mode="continuous").start()
+    reg = ServiceRegistry().start()
+    expo = ExpositionServer().start()
+    try:
+        for addr in (server.address, reg.address, expo.address):
+            payload = _get_json(addr + "/quality")
+            assert payload["active"] is True
+            assert "f0" in payload["reference"]["columns"]
+    finally:
+        query.stop()
+        server.stop()
+        reg.stop()
+        expo.stop()
+
+
+def test_scrape_cluster_quality_merges_two_live_workers(quality_state):
+    """Fleet merge is EXACT across >= 2 live workers: two registered
+    workers exporting this process's monitor merge to 2x its live sketch
+    counts and 2x its joined pairs — counts sum, never averaged."""
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.telemetry.exposition import scrape_cluster
+    model, x, y = _fit_model()
+    reg = ServiceRegistry().start()
+    s1, q1 = serve_pipeline(model, input_cols=["features"],
+                            mode="continuous")
+    s2, q2 = serve_pipeline(model, input_cols=["features"],
+                            mode="continuous")
+    try:
+        Q.get_monitor().configure(sample=1.0, min_live=4)
+        for name, s in (("qa", s1), ("qb", s2)):
+            host, port = s._httpd.server_address[:2]
+            report_server_to_registry(reg.address, name, host, port)
+        rids = []
+        for i in range(8):
+            resp, _ = _post(s1.address,
+                            {"features": [float(v) for v in x[i]]})
+            rids.append(resp.headers["X-Request-Id"])
+        for i, rid in enumerate(rids):
+            Q.record_label(rid, float(y[i]))
+        single = Q.get_monitor().export()
+        snap = scrape_cluster(reg.address, quality=True, slo=True)
+        assert snap.quality is not None
+        assert snap.quality["workers"] == 2
+        merged_f0 = snap.quality["live"]["columns"]["f0"]["hist"]
+        assert merged_f0["count"] == \
+            2 * single["live"]["columns"]["f0"]["hist"]["count"]
+        assert merged_f0["counts"] == [
+            2 * c for c in
+            single["live"]["columns"]["f0"]["hist"]["counts"]]
+        assert snap.quality["eval"]["joined"] == 2 * single["eval"]["joined"]
+        # fleet drift is RECOMPUTED from the merged counts (never
+        # averaged from per-worker scores): psi(ref, summed live counts)
+        # reproduces the reported value exactly
+        expected = Q.psi(
+            single["reference"]["columns"]["f0"]["hist"]["counts"],
+            merged_f0["counts"])
+        np.testing.assert_allclose(snap.quality["drift"]["f0"]["psi"],
+                                   expected, rtol=1e-12)
+    finally:
+        q1.stop()
+        q2.stop()
+        s1.stop()
+        s2.stop()
+        reg.stop()
+
+
+# ------------------------------------------------------------ SLO + watch
+def test_quality_slo_objective_ceiling_floor_and_merge(quality_state):
+    objectives = tslo.quality_objectives(drift_ceiling=0.25,
+                                         metric_floor=0.9)
+    assert [o.kind for o in objectives] == [tslo.QUALITY, tslo.QUALITY]
+    engine = tslo.SLOEngine(objectives=objectives,
+                            registry=reliability_metrics)
+    # no data: burns 0 (a fresh worker never starts life burning)
+    verdict = engine.verdict(notify=False)
+    assert verdict["ok"] and not verdict["burning"]
+    # drift above the ceiling + metric above the floor: only drift burns
+    reliability_metrics.set_gauge(tnames.QUALITY_DRIFT_MAX, 0.5)
+    reliability_metrics.set_gauge(tnames.quality_eval("accuracy"), 0.95)
+    verdict = engine.verdict(notify=False)
+    drift_obj = verdict["objectives"][0]
+    assert drift_obj["burning"] is True
+    assert drift_obj["windows"][0]["burn_rate"] == pytest.approx(2.0)
+    assert verdict["objectives"][1]["burning"] is False
+    assert verdict["burning"] is True
+    # fleet merge: ceiling keeps the WORST (max) worker, floor the min
+    reliability_metrics.set_gauge(tnames.QUALITY_DRIFT_MAX, 0.1)
+    reliability_metrics.set_gauge(tnames.quality_eval("accuracy"), 0.8)
+    calm = engine.verdict(notify=False)
+    merged = tslo.merge_verdicts([verdict, calm])
+    assert merged["objectives"][0]["windows"][0]["value"] == 0.5   # max
+    assert merged["objectives"][1]["windows"][0]["value"] == 0.8   # min
+    assert merged["objectives"][0]["burning"] is True
+    assert merged["objectives"][1]["burning"] is True
+    assert merged["workers"] == 2
+
+
+def test_quality_watch_rules_trip_on_drift_series(quality_state):
+    from mmlspark_tpu.telemetry.watch import TelemetryWatcher
+    watcher = TelemetryWatcher(rules=Q.quality_watch_rules(
+        max_drift=0.25, min_metric=0.9))
+    quiet = {"quality.drift.max": [(1.0, 0.05)],
+             "quality.eval.accuracy": [(1.0, 0.97)]}
+    assert watcher.check(series=quiet) == []
+    breach = {"quality.drift.max": [(1.0, 0.05), (2.0, 0.6)],
+              "quality.eval.accuracy": [(1.0, 0.97), (2.0, 0.5)]}
+    trips = watcher.check(series=breach)
+    assert {t["key"] for t in trips} == {"quality.drift.max",
+                                         "quality.eval.accuracy"}
+    assert watcher.check(series=breach) == []   # transition, not level
+
+
+# ------------------------------------------------------- acceptance (e2e)
+def test_acceptance_shift_moves_drift_trips_watch_burns_slo_bundles(
+        quality_state, tmp_path):
+    """ISSUE 12 acceptance: a seeded feature-distribution shift on the
+    live serving stream moves quality.drift.{col}, trips a watch rule,
+    flips the quality SLO objective to burning, and the flight bundle
+    carries quality.json with per-feature drift rows and streaming-eval
+    state — watch-trip and bundle events in causal (seq) order."""
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.telemetry.watch import TelemetryWatcher
+    tracer = telemetry.get_tracer()
+    tracer.configure(sample=1.0)
+    tracer.clear()
+    rec = perf.get_flight_recorder()
+    rec.configure(bundle_dir=str(tmp_path), min_interval_s=0.0)
+    model, x, y = _fit_model()
+    server, q = serve_pipeline(model, input_cols=["features"],
+                               mode="continuous")
+    engine = tslo.configure(tslo.quality_objectives(drift_ceiling=0.25))
+    try:
+        mon = Q.get_monitor()
+        mon.configure(sample=1.0, min_live=16)
+        rng = np.random.default_rng(12)
+
+        def drive(rows):
+            ids = []
+            for row in rows:
+                resp, _ = _post(server.address,
+                                {"features": [float(v) for v in row]})
+                ids.append(resp.headers["X-Request-Id"])
+            return ids
+
+        # phase 1: in-distribution traffic + labels — healthy baseline
+        # (enough rows that small-sample PSI noise sits well under the
+        # 0.25 ceiling; the smoothing test pins the statistics side)
+        rids = drive(x[:200])
+        for i, rid in enumerate(rids[:32]):
+            Q.record_label(rid, float(y[i]))
+        urllib.request.urlopen(server.address + "/metrics",
+                               timeout=15).read()
+        baseline = reliability_metrics.gauge(tnames.QUALITY_DRIFT_MAX)
+        assert baseline < 0.25
+        assert not _get_json(server.address + "/slo")["burning"]
+
+        # phase 2: the injected shift — every feature moved 5 sigma
+        drive(x[200:400] + 5.0)
+        urllib.request.urlopen(server.address + "/metrics",
+                               timeout=15).read()
+        shifted = reliability_metrics.gauge(tnames.QUALITY_DRIFT_MAX)
+        assert shifted > 0.25 > baseline
+        assert reliability_metrics.gauge(
+            tnames.quality_drift("f0")) > 0.25
+
+        # the watch rule trips on the gauge series
+        watcher = TelemetryWatcher(rules=Q.quality_watch_rules(
+            max_drift=0.25), recorder=None)
+        trips = watcher.check(series={
+            "quality.drift.max": [(1.0, baseline), (2.0, shifted)]})
+        assert [t["key"] for t in trips] == ["quality.drift.max"]
+
+        # the quality SLO flips to burning and the transition dumps a
+        # bundle through the standard flight path
+        verdict = _get_json(server.address + "/slo")
+        obj = {o["objective"]["name"]: o for o in verdict["objectives"]}
+        assert obj["quality.drift"]["burning"] is True
+        assert verdict["burning"] is True
+        deadline = time.monotonic() + 5.0
+        bundles = []
+        while not bundles and time.monotonic() < deadline:
+            bundles = sorted(tmp_path.glob("bundle-*"))
+            time.sleep(0.01)
+        assert bundles, "burning verdict left no flight bundle"
+        quality_dump = json.loads(
+            (bundles[-1] / "quality.json").read_text())
+        assert quality_dump["active"] is True
+        assert quality_dump["drift"]["f0"]["psi"] > 0.25
+        assert quality_dump["eval"]["joined"] == 32
+        assert quality_dump["eval"]["kind"] == "classification"
+        assert "accuracy" in quality_dump["eval"]["metrics"]
+
+        # causal order: watch trip seq precedes the bundle event seq
+        events = {s["name"]: s["seq"] for s in tracer.finished()
+                  if s.get("kind") == "event"}
+        assert tnames.TELEMETRY_WATCH_TRIP_EVENT in events
+        assert tnames.TELEMETRY_BUNDLE_EVENT in events
+        assert events[tnames.TELEMETRY_WATCH_TRIP_EVENT] \
+            < events[tnames.TELEMETRY_BUNDLE_EVENT]
+    finally:
+        tslo.configure(None)
+        rec.configure(bundle_dir="")
+        tracer.configure(sample=0.0)
+        tracer.clear()
+        q.stop()
+        server.stop()
+
+
+def test_flight_bundle_quality_json_inactive(quality_state, tmp_path):
+    """Processes without a reference still dump valid bundles — the
+    quality block degrades to {"active": false}, never a failed dump."""
+    rec = perf.get_flight_recorder()
+    rec.configure(bundle_dir=str(tmp_path), min_interval_s=0.0)
+    try:
+        manifest = rec.dump("quality-degrade-probe")
+        assert manifest is not None
+        dump = json.loads((tmp_path / manifest["path"].split("/")[-1]
+                           / "quality.json").read_text())
+        assert dump == {"active": False}
+        assert "quality.json" in manifest["files"]
+    finally:
+        rec.configure(bundle_dir="")
