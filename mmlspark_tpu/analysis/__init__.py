@@ -42,10 +42,22 @@ BASELINE_FILENAME = "graftlint.baseline.json"
 
 def run(paths: Iterable[str], root: Optional[str] = None,
         baseline_path: Optional[str] = None,
-        rules: Optional[Iterable[Rule]] = None) -> Report:
+        rules: Optional[Iterable[Rule]] = None,
+        tiers: Iterable[str] = ("source",),
+        semantic_rules: Optional[Iterable[str]] = None) -> Report:
     """Analyze `paths` (files/dirs, relative to `root`) with the default
     rule set. `baseline_path=None` auto-loads `graftlint.baseline.json`
-    from `root` when present; pass "" to disable the baseline."""
+    from `root` when present; pass "" to disable the baseline.
+
+    `tiers` selects analysis tiers: "source" (the AST rules over
+    `paths`) and/or "semantic" (jaxpr/HLO contract checks over the
+    registered hot paths — see `analysis.semantic`). Semantic findings
+    merge into the same report and baseline ledger; contract-IMPORT
+    errors additionally land in `report.contract_errors`, which the CLI
+    turns into exit 2 (a moved entrypoint must never gate green).
+    `semantic_rules` filters the semantic tier to a subset of its rule
+    ids."""
+    tiers = tuple(tiers)
     analyzer = Analyzer(rules if rules is not None else default_rules(),
                         root=root)
     if baseline_path is None:
@@ -56,7 +68,18 @@ def run(paths: Iterable[str], root: Optional[str] = None,
         # (and like where --write-baseline puts the file) — never the cwd
         baseline_path = os.path.join(analyzer.root, baseline_path)
     baseline = Baseline.load(baseline_path) if baseline_path else None
-    return analyzer.run(paths, baseline=baseline)
+    extra, contract_errors = [], []
+    if "semantic" in tiers:
+        from .semantic import run_semantic
+
+        sem = run_semantic(root=analyzer.root, rules=semantic_rules)
+        extra = sem.findings
+        contract_errors = sem.errors
+    report = analyzer.run(paths if "source" in tiers else [],
+                          baseline=baseline,
+                          extra_findings=extra + contract_errors)
+    report.contract_errors = contract_errors
+    return report
 
 
 __all__ = ["Analyzer", "Baseline", "Finding", "Module", "Project",

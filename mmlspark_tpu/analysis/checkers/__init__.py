@@ -6,8 +6,8 @@ from .locks import LockBlockingCallRule, LockOrderCycleRule
 from .markers import PytestMarkerRule
 from .names import (MetricKindCollisionRule, MetricNameRule,
                     MetricNameUndocumentedRule)
-from .tracing import (TraceMutableClosureRule, TraceNumpyCallRule,
-                      TracePythonBranchRule)
+from .tracing import (TraceHostSyncRule, TraceMutableClosureRule,
+                      TraceNumpyCallRule, TracePythonBranchRule)
 
 
 def default_rules():
@@ -18,6 +18,7 @@ def default_rules():
         TracePythonBranchRule(),
         TraceNumpyCallRule(),
         TraceMutableClosureRule(),
+        TraceHostSyncRule(),
         WallClockRule(),
         LegacyRandomRule(),
         SetIterationRule(),

@@ -335,3 +335,60 @@ def _module_globals(module: Module) -> Set[str]:
                 for a in n.names:
                     out.add((a.asname or a.name).split(".")[0])
     return out
+
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_SYNC_NP_FNS = {"asarray", "array"}
+
+
+class TraceHostSyncRule(Rule):
+    name = "trace-host-sync"
+    severity = "error"
+    description = ("host-sync call (float()/[.item()]/np.asarray/"
+                   "block_until_ready) on a traced value inside a for/while "
+                   "body of a traced function (a device round-trip per "
+                   "iteration — the semantic tier's AST companion)")
+
+    def _sync_kind(self, node: ast.Call, traced, np_aliases) -> Optional[str]:
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in _HOST_SYNC_BUILTINS):
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if _traced_names_in(a, traced, node):
+                    return f"{node.func.id}(...)"
+            return None
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+                and _traced_names_in(node.func.value, traced, node)):
+            return f".{node.func.attr}()"
+        fname = dotted_name(node.func)
+        if fname is not None:
+            root, leaf = fname.split(".")[0], fname.split(".")[-1]
+            if (root in np_aliases and root != fname
+                    and leaf in _HOST_SYNC_NP_FNS):
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    if _traced_names_in(a, traced, node):
+                        return f"{fname}(...)"
+        return None
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if module.is_test:
+            return
+        np_aliases = _numpy_aliases(module)
+        for t in _find_traced(module):
+            traced = t.traced_params - _shadowed_params(t)
+            for loop in _body_nodes(t.fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    kind = self._sync_kind(node, traced, np_aliases)
+                    if kind is not None:
+                        yield module.finding(
+                            self, node,
+                            f"`{kind}` on traced argument inside a "
+                            f"{type(loop).__name__.lower()} body of a "
+                            f"{t.how}-traced function — a device->host "
+                            f"sync EVERY iteration; fetch once after the "
+                            f"loop (or keep it in-graph)")

@@ -42,18 +42,31 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings covered by the baseline")
     p.add_argument("--select", default=None, metavar="RULES",
-                   help="comma-separated rule names to run (default: all)")
+                   help="comma-separated rule names to run (default: all); "
+                        "semantic.* ids select semantic-tier checkers")
+    p.add_argument("--all-tiers", action="store_true",
+                   help="also run the semantic tier (jaxpr/HLO contract "
+                        "checks over the registered hot paths; needs jax)")
     p.add_argument("--list-rules", action="store_true")
     return p
 
 
 def main(argv: Optional[list] = None) -> int:
+    from .semantic import SEMANTIC_RULES
+
     args = _build_parser().parse_args(argv)
     rules = default_rules()
     if args.list_rules:
+        print("source tier (AST, stdlib-only):")
         for r in rules:
-            print(f"{r.name:26s} [{r.severity}] {r.description}")
+            print(f"  {r.name:30s} [{r.severity}] {r.description}")
+        print("semantic tier (jaxpr/HLO contracts; --all-tiers or "
+              "--select semantic.*):")
+        for name, (sev, desc) in SEMANTIC_RULES.items():
+            print(f"  {name:30s} [{sev}] {desc}")
         return 0
+    semantic_rules = None          # None = all, when the tier runs
+    run_semantic_tier = args.all_tiers
     if args.select:
         wanted = {s.strip() for s in args.select.split(",") if s.strip()}
         # MetricNameRule owns a second reporting id: selecting the typo id
@@ -61,8 +74,16 @@ def main(argv: Optional[list] = None) -> int:
         if "metric-name-typo" in wanted:
             wanted.add("metric-name-unknown")
             wanted.discard("metric-name-typo")
+        sem_wanted = {w for w in wanted if w.startswith("semantic.")}
+        if sem_wanted:
+            # selecting a semantic id turns the tier on; the source
+            # rules then run only if source ids were also selected
+            run_semantic_tier = True
+            semantic_rules = sorted(sem_wanted)
+        wanted -= sem_wanted
         rules = [r for r in rules if r.name in wanted]
-        unknown = wanted - {r.name for r in rules}
+        unknown = ((wanted - {r.name for r in rules})
+                   | (sem_wanted - set(SEMANTIC_RULES)))
         if unknown:
             print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)
@@ -87,7 +108,13 @@ def main(argv: Optional[list] = None) -> int:
             print("graftlint: --write-baseline needs a baseline path "
                   "(got '')", file=sys.stderr)
             return 2
-        report = run(args.paths, root=root, baseline_path="", rules=rules)
+        report = run(args.paths, root=root, baseline_path="", rules=rules,
+                     tiers=_tiers(True, args.all_tiers))
+        if report.contract_errors:
+            # a broken contract registry must never be baselined away
+            for f in report.contract_errors:
+                print(repr(f), file=sys.stderr)
+            return 2
         path = os.path.join(root, args.baseline or BASELINE_FILENAME)
         Baseline.from_findings(report.findings).save(path)
         print(f"graftlint: baselined {len(report.findings)} finding(s) "
@@ -95,7 +122,10 @@ def main(argv: Optional[list] = None) -> int:
         return 0
     try:
         report = run(args.paths, root=root, baseline_path=args.baseline,
-                     rules=rules)
+                     rules=rules,
+                     tiers=_tiers(bool(rules) or not args.select,
+                                  run_semantic_tier),
+                     semantic_rules=semantic_rules)
     except OSError as e:
         print(f"graftlint: cannot read baseline: {e}", file=sys.stderr)
         return 2
@@ -109,9 +139,19 @@ def main(argv: Optional[list] = None) -> int:
         # (and park stdout on devnull so the shutdown flush stays quiet)
         # but still exit with the real gating code
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    if report.contract_errors:
+        # moved/renamed contract entrypoints are a usage error, not a
+        # finding to baseline: exit 2 so CI can't gate green on a
+        # registry that silently analyzes zero contracts
+        return 2
     gating = [f for f in report.active
               if args.strict or f.severity == "error"]
     return 1 if gating or report.skipped else 0
+
+
+def _tiers(source: bool, semantic: bool) -> tuple:
+    return (("source",) if source else ()) + (
+        ("semantic",) if semantic else ())
 
 
 if __name__ == "__main__":

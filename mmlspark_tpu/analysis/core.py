@@ -43,17 +43,18 @@ SEVERITIES = ("error", "warning")
 
 _DISABLE_RE = re.compile(
     r"#\s*graftlint:\s*disable(?P<scope>-file)?\s*=\s*"
-    r"(?P<rules>[A-Za-z0-9_\-,\s]+)")
+    r"(?P<rules>[A-Za-z0-9_\-.,\s]+)")  # '.' for semantic.* rule ids
 
 
 class Finding:
     """One rule violation at a source location."""
 
     __slots__ = ("rule", "path", "line", "col", "message", "severity",
-                 "baselined")
+                 "baselined", "tier")
 
     def __init__(self, rule: str, path: str, line: int, col: int,
-                 message: str, severity: str = "error"):
+                 message: str, severity: str = "error",
+                 tier: str = "source"):
         if severity not in SEVERITIES:
             raise ValueError(f"severity must be one of {SEVERITIES}")
         self.rule = rule
@@ -63,6 +64,8 @@ class Finding:
         self.message = message
         self.severity = severity
         self.baselined = False
+        self.tier = tier                 # "source" (AST) or "semantic"
+
 
     def key(self) -> str:
         """Baseline identity: rule + file + message, NOT the line number —
@@ -72,7 +75,8 @@ class Finding:
     def to_dict(self) -> dict:
         return {"rule": self.rule, "path": self.path, "line": self.line,
                 "col": self.col, "message": self.message,
-                "severity": self.severity, "baselined": self.baselined}
+                "severity": self.severity, "baselined": self.baselined,
+                "tier": self.tier}
 
     def __repr__(self):
         return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
@@ -212,28 +216,41 @@ class Rule:
 
 
 class Baseline:
-    """Committed debt ledger: `finding key -> count`."""
+    """Committed debt ledger: `finding key -> count` (plus, since the
+    semantic tier, `key -> tier` — absent entries default to "source",
+    which keeps every committed v1 baseline valid unchanged)."""
 
-    def __init__(self, counts: Optional[dict] = None):
+    def __init__(self, counts: Optional[dict] = None,
+                 tiers: Optional[dict] = None):
         self.counts = dict(counts or {})
+        self.tiers = dict(tiers or {})
 
     @classmethod
     def load(cls, path: str) -> "Baseline":
         with open(path, encoding="utf-8") as f:
             data = json.load(f)
-        return cls(data.get("findings", data) if isinstance(data, dict)
-                   else {})
+        if not isinstance(data, dict):
+            return cls({})
+        return cls(data.get("findings", data), data.get("tiers", {}))
 
     @classmethod
     def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
         counts: dict = {}
+        tiers: dict = {}
         for f in findings:
             counts[f.key()] = counts.get(f.key(), 0) + 1
-        return cls(counts)
+            if f.tier != "source":
+                tiers[f.key()] = f.tier
+        return cls(counts, tiers)
 
     def save(self, path: str) -> None:
         payload = {"format": "graftlint-baseline-v1",
                    "findings": dict(sorted(self.counts.items()))}
+        if self.tiers:
+            # the tier map is additive: v1 readers (and the committed
+            # empty baseline) ignore it; omit when empty so a
+            # source-only ledger round-trips byte-identically
+            payload["tiers"] = dict(sorted(self.tiers.items()))
         with open(path, "w", encoding="utf-8") as f:
             json.dump(payload, f, indent=1, sort_keys=True)
             f.write("\n")
@@ -255,6 +272,8 @@ class Report:
         self.findings = findings
         self.files = files
         self.skipped = skipped   # unparseable files (reported separately)
+        self.contract_errors: List[Finding] = []   # semantic registry
+        # failures (also present in findings; tracked for exit 2)
 
     @property
     def active(self) -> List[Finding]:
@@ -333,9 +352,13 @@ class Analyzer:
         return Project(self.root, modules)
 
     def run(self, paths: Iterable[str],
-            baseline: Optional[Baseline] = None) -> Report:
+            baseline: Optional[Baseline] = None,
+            extra_findings: Optional[List[Finding]] = None) -> Report:
+        """`extra_findings` (e.g. the semantic tier's, already
+        suppression-filtered by their own runner) merge in before the
+        sort and the baseline pass, so one ledger covers both tiers."""
         project = self.load(paths)
-        findings: List[Finding] = []
+        findings: List[Finding] = list(extra_findings or ())
         skipped = [m.rel for m in project.modules if m.tree is None]
         for rule in self.rules:
             for m in project.modules:

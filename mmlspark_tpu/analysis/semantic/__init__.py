@@ -1,0 +1,49 @@
+"""graftsem: the semantic (jaxpr/HLO) analysis tier.
+
+graftlint's source tier guards what is visible in the AST; the bugs
+that actually cost this repo performance live below it — the LM step
+that silently compiled TWO executables (PR 4), a GSPMD reshard adding
+an all-gather to a pod-slice hot path, a donated buffer the host still
+reads. This tier imports each REGISTERED hot-path entrypoint
+(`semantic.registry.ENTRYPOINTS`), abstractly lowers it on the CPU
+backend, and checks the lowered program against its declared
+`HotPathContract`:
+
+- `semantic.executable-identity`: fresh/steady/restored layouts of one
+  fingerprint must collapse to ONE executable hash;
+- `semantic.donation`: the declared donation set, exactly — and never a
+  buffer the host reuses after the step;
+- `semantic.host-sync`: no callback/outfeed primitives off the
+  allowlist, fetched outputs under the byte budget;
+- `semantic.collective-budget`: optimized-module collective ops/bytes
+  within the declared per-kind budget;
+- `semantic.recompile-hazard`: no weak-type python scalars or
+  unbucketed dynamic shapes in the signature.
+
+Findings flow through the same core/CLI/baseline machinery as the
+source tier (`python -m mmlspark_tpu.analysis --strict --all-tiers`);
+suppression is the standard `# graftlint: disable=semantic.<rule>`
+comment on the contract declaration line. Everything importable from
+this package root is stdlib-only; jax is touched lazily inside the
+runner under the `executable_analysis` never-raise degradation
+contract.
+"""
+from .checkers import SEMANTIC_RULES
+from .contracts import Case, HotPathContract, hot_path_contract
+
+__all__ = ["Case", "HotPathContract", "hot_path_contract",
+           "SEMANTIC_RULES", "run_semantic", "SemanticReport"]
+
+
+def run_semantic(*args, **kwargs):
+    from .runner import run_semantic as _run
+
+    return _run(*args, **kwargs)
+
+
+def __getattr__(name):
+    if name == "SemanticReport":
+        from .runner import SemanticReport
+
+        return SemanticReport
+    raise AttributeError(name)

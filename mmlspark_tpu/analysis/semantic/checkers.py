@@ -1,0 +1,233 @@
+"""The five semantic checkers: lowered program vs declared contract.
+
+Each checker is a pure function `(contract, rel, cases) -> findings`
+over the `LoweredCase` bundles — it reads only the fields that survived
+lowering (degraded fields silence the checks that need them, never the
+whole contract) and anchors every finding at the contract declaration's
+file:line, where the `# graftlint: disable=semantic.<rule>` suppression
+and the fix both live.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..core import Finding
+from .contracts import HotPathContract
+from .lowering import LoweredCase, aval_bytes, host_sync_primitives
+
+# rule id -> (severity, description); the CLI's --list-rules and
+# --select validate against this catalog (stdlib-only: importable
+# without jax, like the rest of the analyzer's metadata)
+SEMANTIC_RULES = {
+    "semantic.executable-identity": (
+        "error",
+        "same hot-path fingerprint lowers to more executables than the "
+        "contract declares (fresh/steady/restored layouts must collapse)"),
+    "semantic.donation": (
+        "error",
+        "declared steady-state buffers not donated, donation the contract "
+        "does not declare, or a donated buffer the host still reuses"),
+    "semantic.host-sync": (
+        "error",
+        "device->host transfer inside the hot path: callback/outfeed "
+        "primitives off the allowlist, or fetched outputs over the "
+        "contract's host-transfer byte budget"),
+    "semantic.collective-budget": (
+        "error",
+        "optimized-module collective traffic exceeds the contract's "
+        "per-kind ops/bytes budget (or a kind the contract never declared)"),
+    "semantic.recompile-hazard": (
+        "error",
+        "python-scalar (weak-type) leaves or unbucketed dynamic shapes in "
+        "the contract signature that would fragment compile-log "
+        "fingerprints"),
+    "semantic.contract-import": (
+        "error",
+        "a registered contract entrypoint failed to import or resolve — "
+        "the path is silently unanalyzed until the registry is fixed"),
+}
+
+
+def _finding(contract: HotPathContract, rel: str, rule: str,
+             message: str) -> Finding:
+    return Finding(rule, rel, contract.line, 0, message,
+                   severity=SEMANTIC_RULES[rule][0], tier="semantic")
+
+
+def check_executable_identity(contract: HotPathContract, rel: str,
+                              cases: List[LoweredCase]) -> Iterable[Finding]:
+    out: List[Finding] = []
+    # mixed-basis fingerprints are incomparable (optimized HLO vs
+    # StableHLO of the same program differ trivially): compare within
+    # one basis only — partial degradation narrows, never false-alarms
+    by_basis: dict = {}
+    for lc in cases:
+        if lc.fingerprint is not None:
+            by_basis.setdefault(lc.fingerprint_basis, []).append(lc)
+    for basis_cases in by_basis.values():
+        groups: dict = {}
+        for lc in basis_cases:
+            groups.setdefault(lc.group or contract.name, {}).setdefault(
+                lc.fingerprint, []).append(lc.name)
+        for group, fps in groups.items():
+            if len(fps) > 1:
+                variants = "; ".join(
+                    f"{fp[:10]}<-{{{', '.join(names)}}}"
+                    for fp, names in sorted(fps.items()))
+                out.append(_finding(
+                    contract, rel, "semantic.executable-identity",
+                    f"{contract.name}: group '{group}' lowers to "
+                    f"{len(fps)} distinct executables ({variants}) — "
+                    f"identical-layout cases must hit ONE"))
+        if len(groups) > 1 or contract.expected_executables > 1:
+            distinct = {fp for fps in groups.values() for fp in fps}
+            if len(distinct) > contract.expected_executables:
+                out.append(_finding(
+                    contract, rel, "semantic.executable-identity",
+                    f"{contract.name}: {len(distinct)} distinct "
+                    f"executables across {len(basis_cases)} cases, "
+                    f"contract allows {contract.expected_executables}"))
+    return out
+
+
+def check_donation(contract: HotPathContract, rel: str,
+                   cases: List[LoweredCase]) -> Iterable[Finding]:
+    out: List[Finding] = []
+    expected = set(contract.donate_expected)
+    reused = set(contract.reused_after_step)
+    for lc in cases:
+        if lc.donated_args is None:
+            continue
+        actual = set(lc.donated_args)
+        missing = expected - actual
+        if missing:
+            out.append(_finding(
+                contract, rel, "semantic.donation",
+                f"{contract.name}/{lc.name}: steady-state arg(s) "
+                f"{sorted(missing)} not donated — each step leaks a "
+                f"buffer-sized allocation"))
+        extra = actual - expected
+        if extra:
+            out.append(_finding(
+                contract, rel, "semantic.donation",
+                f"{contract.name}/{lc.name}: arg(s) {sorted(extra)} "
+                f"donated but not declared in the contract"))
+        conflicted = actual & reused
+        if conflicted:
+            out.append(_finding(
+                contract, rel, "semantic.donation",
+                f"{contract.name}/{lc.name}: arg(s) {sorted(conflicted)} "
+                f"donated but reused by the host after the step — "
+                f"use-after-donation"))
+    return out
+
+
+def check_host_sync(contract: HotPathContract, rel: str,
+                    cases: List[LoweredCase]) -> Iterable[Finding]:
+    out: List[Finding] = []
+    allowed = set(contract.allowed_callbacks)
+    for lc in cases:
+        if lc.jaxpr is not None:
+            bad = sorted(set(host_sync_primitives(lc.jaxpr)) - allowed)
+            if bad:
+                out.append(_finding(
+                    contract, rel, "semantic.host-sync",
+                    f"{contract.name}/{lc.name}: host-sync primitive(s) "
+                    f"{bad} inside the hot path (not on the contract's "
+                    f"callback allowlist)"))
+        if (contract.max_host_transfer_bytes is not None
+                and lc.out_avals is not None):
+            idx = (contract.host_fetch_outputs
+                   or tuple(range(len(lc.out_avals))))
+            # negative indices count from the end, python-style, so a
+            # contract can say "the last output" without pinning arity
+            idx = tuple(i if i >= 0 else len(lc.out_avals) + i
+                        for i in idx)
+            nbytes = sum(aval_bytes(lc.out_avals[i]) for i in idx
+                         if 0 <= i < len(lc.out_avals))
+            if nbytes > contract.max_host_transfer_bytes:
+                out.append(_finding(
+                    contract, rel, "semantic.host-sync",
+                    f"{contract.name}/{lc.name}: host fetches {nbytes} "
+                    f"bytes/step, contract caps "
+                    f"{contract.max_host_transfer_bytes}"))
+    return out
+
+
+def check_collective_budget(contract: HotPathContract, rel: str,
+                            cases: List[LoweredCase]) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for lc in cases:
+        if lc.collectives is None:
+            continue
+        for kind, ent in sorted(lc.collectives.items()):
+            budget = contract.collective_budget.get(kind)
+            if budget is None:
+                out.append(_finding(
+                    contract, rel, "semantic.collective-budget",
+                    f"{contract.name}/{lc.name}: undeclared collective "
+                    f"'{kind}' ({ent['ops']} op(s), {ent['bytes']} B) in "
+                    f"the optimized module — a GSPMD reshard the "
+                    f"contract never budgeted"))
+                continue
+            over = []
+            if ent["ops"] > budget.get("ops", float("inf")):
+                over.append(f"{ent['ops']} ops > {budget['ops']}")
+            if ent["bytes"] > budget.get("bytes", float("inf")):
+                over.append(f"{ent['bytes']} B > {budget['bytes']}")
+            if over:
+                out.append(_finding(
+                    contract, rel, "semantic.collective-budget",
+                    f"{contract.name}/{lc.name}: '{kind}' over budget "
+                    f"({'; '.join(over)})"))
+    return out
+
+
+def _python_scalar_args(args) -> list:
+    import jax
+
+    hits = []
+    for i, a in enumerate(args):
+        for leaf in jax.tree_util.tree_leaves(a):
+            if isinstance(leaf, (bool, int, float)):
+                hits.append(i)
+                break
+    return hits
+
+
+def check_recompile_hazard(contract: HotPathContract, rel: str,
+                           cases: List[LoweredCase]) -> Iterable[Finding]:
+    out: List[Finding] = []
+    ok = set(contract.weak_type_ok)
+    for lc in cases:
+        weak = [i for i in _python_scalar_args(lc.case.args) if i not in ok]
+        if weak:
+            out.append(_finding(
+                contract, rel, "semantic.recompile-hazard",
+                f"{contract.name}/{lc.name}: python-scalar arg(s) {weak} "
+                f"trace as weak types — promotion depends on the other "
+                f"operand and fragments compile-log fingerprints"))
+        for arg_i, (axis, allowed) in sorted(
+                contract.shape_buckets.items()):
+            if arg_i >= len(lc.case.args):
+                continue
+            shape = getattr(lc.case.args[arg_i], "shape", None)
+            if shape is None or axis >= len(shape):
+                continue
+            if shape[axis] not in allowed:
+                out.append(_finding(
+                    contract, rel, "semantic.recompile-hazard",
+                    f"{contract.name}/{lc.name}: arg {arg_i} dim {axis} "
+                    f"= {shape[axis]} is not in the declared shape "
+                    f"buckets {tuple(sorted(allowed))} — every novel "
+                    f"size compiles a fresh executable"))
+    return out
+
+
+ALL_CHECKERS = (
+    check_executable_identity,
+    check_donation,
+    check_host_sync,
+    check_collective_budget,
+    check_recompile_hazard,
+)

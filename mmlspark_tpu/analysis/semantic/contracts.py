@@ -1,0 +1,91 @@
+"""HotPathContract: the declared truth a hot path is checked against.
+
+A contract lives NEXT TO the code it covers (the LM trainer declares the
+LM step contract; `io/plan.py` declares the serving-plan contract) as a
+decorated zero-arg builder returning concrete `Case`s — (fn, args)
+pairs small enough to lower on the CPU backend in tier-1. The decorator
+records the declaration's file:line so every semantic finding anchors
+where the contract (and usually the bug) lives, and so the standard
+`# graftlint: disable=semantic.<rule>` suppression machinery applies.
+
+The builder is LAZY: declaring a contract costs nothing at import time
+(no jax work happens until the semantic runner calls `build()`), which
+keeps product-module import cheap and lets the analyzer's source tier
+stay jax-free.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class Case:
+    """One concrete lowering of a hot path: `jax.jit(fn, **jit_kwargs)`
+    lowered at `args`. Static parameters must be pre-bound (e.g. with
+    `functools.partial`) so `args` is pure array/pytree data; `group`
+    names the executable-identity bucket the case belongs to (cases in
+    one group must collapse to one executable; default: the contract)."""
+
+    name: str
+    fn: Callable
+    args: tuple
+    jit_kwargs: dict = dataclasses.field(default_factory=dict)
+    group: str = ""
+
+
+@dataclasses.dataclass
+class HotPathContract:
+    """Declared invariants of one registered hot path.
+
+    Budgets are MAXIMA: fewer devices (or a smaller mesh) than the
+    canonical tier-1 eight lowers less traffic and still passes; a
+    GSPMD-introduced collective kind (absent from `collective_budget`)
+    or more ops/bytes than declared fails. `donate_expected` /
+    `reused_after_step` are USER-ARG indices (pytree args count as one),
+    resolved against flattened jit parameters by the lowering layer.
+    """
+
+    name: str
+    build: Callable[[], Sequence[Case]]
+    path: str                      # declaration file (absolute; runner
+    line: int                      # relativizes), line of the decorator
+    expected_executables: int = 1
+    donate_expected: Tuple[int, ...] = ()
+    reused_after_step: Tuple[int, ...] = ()
+    allowed_callbacks: Tuple[str, ...] = ()
+    host_fetch_outputs: Tuple[int, ...] = ()   # flat output indices the
+    max_host_transfer_bytes: Optional[int] = None   # host fetches per step
+    collective_budget: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)  # kind -> {"ops": max, "bytes": max}
+    weak_type_ok: Tuple[int, ...] = ()  # args allowed to be python scalars
+    shape_buckets: Dict[int, tuple] = dataclasses.field(
+        default_factory=dict)  # arg index -> (axis, (allowed sizes, ...))
+
+    def cases(self) -> Sequence[Case]:
+        return self.build()
+
+
+def hot_path_contract(name: str, **fields) -> Callable:
+    """Declare a hot-path contract over a zero-arg case builder::
+
+        @hot_path_contract("lm.step", donate_expected=(0, 1))
+        def lm_step_contract():
+            ...
+            return [Case("fresh", fn, args), ...]
+
+    The decorated function becomes the `HotPathContract` (the semantic
+    registry resolves it by attribute name)."""
+
+    def deco(build: Callable) -> HotPathContract:
+        code = getattr(build, "__code__", None)
+        return HotPathContract(
+            name=name, build=build,
+            path=getattr(code, "co_filename", "<unknown>"),
+            line=getattr(code, "co_firstlineno", 0), **fields)
+
+    return deco
+
+
+def contract_names(contracts: Iterable[HotPathContract]) -> list:
+    return [c.name for c in contracts]

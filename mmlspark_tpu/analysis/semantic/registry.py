@@ -1,0 +1,80 @@
+"""The hot-path contract registry: every entrypoint the semantic tier
+analyzes, by dotted module + attribute name.
+
+Import errors are LOUD by design: an entrypoint that moved or was
+renamed produces a `semantic.contract-import` finding pointing at the
+ENTRYPOINTS table below and fails the run with exit 2 — the mirror of
+graftlint's nonexistent-path fix. Silently analyzing zero contracts
+would gate green forever while every checked invariant rots.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from ..core import Finding
+from .contracts import HotPathContract
+
+# (module, attribute) pairs resolving to HotPathContract objects; keep
+# this table sorted by module so a diff reads as an inventory change
+ENTRYPOINTS: Tuple[Tuple[str, str], ...] = (
+    ("mmlspark_tpu.io.plan", "serving_plan_contract"),
+    ("mmlspark_tpu.models.dnn.lm_training", "lm_step_contract"),
+    ("mmlspark_tpu.models.gbdt.boosting", "gbdt_fused_chunk_contract"),
+    ("mmlspark_tpu.models.gbdt.distributed", "gbdt_chunk_distributed_contract"),
+    ("mmlspark_tpu.models.gbdt.distributed", "gbdt_tree_distributed_contract"),
+    ("mmlspark_tpu.ops.histogram", "gbdt_hist_route_contract"),
+)
+
+
+def _registry_location() -> tuple:
+    """(rel-style path, line) of the ENTRYPOINTS table in THIS file —
+    the anchor for contract-import findings."""
+    path = os.path.abspath(__file__)
+    try:
+        with open(path, encoding="utf-8") as f:
+            for lineno, text in enumerate(f, start=1):
+                if text.startswith("ENTRYPOINTS"):
+                    return path, lineno
+    except OSError:
+        pass
+    return path, 0
+
+
+def load_contracts(entrypoints: Optional[Sequence[Tuple[str, str]]] = None
+                   ) -> tuple:
+    """Resolve every registered entrypoint.
+
+    Returns `(contracts, errors)` where `errors` are
+    `semantic.contract-import` Findings (file:line of the registry
+    table) for entrypoints that failed to import, failed to resolve, or
+    resolved to something that is not a HotPathContract."""
+    contracts: List[HotPathContract] = []
+    errors: List[Finding] = []
+    path, line = _registry_location()
+    for mod_name, attr in (ENTRYPOINTS if entrypoints is None
+                           else entrypoints):
+        try:
+            mod = importlib.import_module(mod_name)
+        except Exception as e:  # noqa: BLE001 - any import failure gates
+            errors.append(Finding(
+                "semantic.contract-import", path, line, 0,
+                f"cannot import contract module '{mod_name}' "
+                f"({type(e).__name__}: {e})", tier="semantic"))
+            continue
+        obj = getattr(mod, attr, None)
+        if obj is None:
+            errors.append(Finding(
+                "semantic.contract-import", path, line, 0,
+                f"contract entrypoint '{mod_name}:{attr}' does not exist "
+                f"(moved or renamed? update ENTRYPOINTS)", tier="semantic"))
+            continue
+        if not isinstance(obj, HotPathContract):
+            errors.append(Finding(
+                "semantic.contract-import", path, line, 0,
+                f"'{mod_name}:{attr}' is {type(obj).__name__}, not a "
+                f"HotPathContract", tier="semantic"))
+            continue
+        contracts.append(obj)
+    return contracts, errors

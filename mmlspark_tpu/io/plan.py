@@ -397,3 +397,52 @@ def compile_serving_transform(model, input_cols: Sequence[str],
     `max_plans` bounds the LRU plan cache (`serving.plan.evictions`)."""
     return ServingTransform(model, input_cols, output_col,
                             max_bucket=max_bucket, max_plans=max_plans)
+
+
+# --------------------------------------------------- semantic contract
+# Registered in analysis/semantic/registry.py: the serving hot path is
+# a jitted model forward dispatched per (fingerprint, shape-bucket) —
+# one executable PER canonical bucket, zero recompiles WITHIN one. The
+# contract lowers a DNNModel forward (the jax-backed serving kernel;
+# tree scoring is a host kernel with nothing to lower) at the canonical
+# power-of-two buckets, twice per bucket: same-bucket lowerings must
+# collapse (`plan.recompiles == 0`, statically) and the total distinct
+# count must equal the bucket count.
+from ..analysis.semantic import Case, hot_path_contract  # noqa: E402
+
+_CANONICAL_BUCKETS = (8, 16, 32)
+
+
+@hot_path_contract(
+    "serving.plan",
+    expected_executables=len(_CANONICAL_BUCKETS),
+    donate_expected=(),          # serving inputs are request data; a
+                                 # donated input would corrupt retries
+    collective_budget={},        # single-replica forward: no collectives
+    # requests must land ON a canonical bucket (pad_rows_to_bucket's
+    # output); an off-bucket batch is a fresh executable per novel size
+    shape_buckets={0: (0, _CANONICAL_BUCKETS)},
+)
+def serving_plan_contract():
+    import numpy as _np
+
+    from ..models.dnn.model import DNNModel
+
+    def apply_fn(params, xb):
+        import jax.numpy as jnp
+        h = jnp.maximum(xb @ params["w1"] + params["b1"], 0.0)
+        return h @ params["w2"]
+
+    import jax.numpy as jnp
+    rng = _np.random.default_rng(0)
+    params = {"w1": jnp.asarray(rng.normal(size=(6, 16)), jnp.float32),
+              "b1": jnp.zeros(16, jnp.float32),
+              "w2": jnp.asarray(rng.normal(size=(16, 1)), jnp.float32)}
+    fn = DNNModel(apply_fn=apply_fn, params=params)._compiled()
+    cases = []
+    for bucket in _CANONICAL_BUCKETS:
+        for variant in ("fresh", "repeat"):
+            x = jnp.asarray(rng.normal(size=(bucket, 6)), jnp.float32)
+            cases.append(Case(f"bucket{bucket}-{variant}", fn, (x,),
+                              group=f"bucket{bucket}"))
+    return cases

@@ -491,3 +491,57 @@ def lm_state_from_payload(payload, live_params, live_opt_state, meta):
     placed = [place(a, live) for a, live in zip(o_leaves, live_leaves)]
     opt_state = jax.tree_util.tree_unflatten(structure, placed)
     return restored_params, opt_state
+
+
+# --------------------------------------------------- semantic contract
+# Registered in analysis/semantic/registry.py; the analyzer lowers the
+# SAME train_step the trainer jits, at the three argument layouts that
+# historically diverged (the PR-4 two-executables bug), and holds the
+# lowered program to this declaration in tier-1.
+from ...analysis.semantic import Case, hot_path_contract  # noqa: E402
+
+
+@hot_path_contract(
+    "lm.step",
+    expected_executables=1,      # fresh == steady == restored
+    # the analysis backend is CPU, where the trainer deliberately does
+    # NOT donate (multi-device CPU aliasing SIGABRTs under collective
+    # programs — see __init__); any donation appearing here is the
+    # hazard, so the declared set is empty
+    donate_expected=(),
+    # the canonical (dp=4, tp=2) analysis-mesh lowering measured
+    # all-reduce 29 ops/56804 B (TP matmul reductions + the dp gradient
+    # psum), all-gather 3/24576 (embedding + output collection), and
+    # all-to-all 6/12288 (head-parallel attention resharding); budgets
+    # are those maxima with ~2x headroom — a NEW kind or a GSPMD
+    # reshard inflating one fails --strict
+    collective_budget={"all-reduce": {"ops": 40, "bytes": 120_000},
+                       "all-gather": {"ops": 6, "bytes": 50_000},
+                       "all-to-all": {"ops": 12, "bytes": 25_000}},
+    # the host fetches ONE f32 loss scalar per step (trainer.step's
+    # float(loss)); params/opt state stay on device
+    host_fetch_outputs=(-1,),
+    max_host_transfer_bytes=4,
+)
+def lm_step_contract():
+    """fresh / steady / restored layouts of one LM step fingerprint."""
+    import numpy as _np
+
+    trainer = ShardedLMTrainer(vocab_size=64, mesh=None, d_model=32,
+                               n_heads=2, n_layers=1, d_ff=64, max_len=16,
+                               seed=0)
+    tokens_np = _np.arange(8 * 16, dtype=_np.int32).reshape(8, 16) % 64
+    tokens = trainer._to_device(tokens_np)
+    kw = dict(donate_argnums=trainer._donate,
+              out_shardings=trainer._out_shardings)
+    fresh = (trainer.params, trainer.opt_state, tokens)
+    trainer.step(tokens_np)        # params/opt_state become jit outputs
+    steady = (trainer.params, trainer.opt_state, tokens)
+    payload = lm_state_payload(trainer.params, trainer.opt_state,
+                               trainer.meta)
+    r_params, r_opt = lm_state_from_payload(payload, trainer.params,
+                                            trainer.opt_state, trainer.meta)
+    restored = (r_params, r_opt, tokens)
+    return [Case("fresh", trainer._step_fn, fresh, kw),
+            Case("steady", trainer._step_fn, steady, kw),
+            Case("restored", trainer._step_fn, restored, kw)]

@@ -995,3 +995,47 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
         # early stop: persist the truncated model and mark training complete
         checkpoint_fn(n_grown, final_booster, base, final=True)
     return final_booster, base, eval_history
+
+
+# --------------------------------------------------- semantic contract
+# Registered in analysis/semantic/registry.py: the fused boosting chunk
+# (the single-host hot path above) lowered at a tiny canonical shape.
+# Single host => zero collectives; nothing donated; no callbacks.
+from ...analysis.semantic import Case, hot_path_contract  # noqa: E402
+
+
+@hot_path_contract(
+    "gbdt.chunk.fused",
+    expected_executables=1,
+    donate_expected=(),
+    collective_budget={},        # axis_name=None: any collective is a bug
+)
+def gbdt_fused_chunk_contract():
+    """Two identical-layout chunk lowerings must share one executable."""
+    import functools as _ft
+
+    import numpy as _np
+
+    p = BoostParams(objective="binary", num_iterations=2, num_leaves=7,
+                    max_depth=2, max_bin=15, min_data_in_leaf=1)
+    cfg = trainer.TreeConfig(n_features=4, n_bins=16, max_depth=2,
+                             num_leaves=7, learning_rate=p.learning_rate,
+                             min_data_in_leaf=1)
+    n = 64
+    rng = _np.random.default_rng(0)
+    fn = _ft.partial(getattr(_boost_chunk, "__wrapped__", _boost_chunk),
+                     p=p, cfg=cfg, chunk_len=2, k_out=1, axis_name=None,
+                     has_valid=False, voting_top_k=None, plane_lo=0)
+
+    def args():
+        d_bins = jnp.asarray(rng.integers(0, 16, (n, 4)), jnp.uint8)
+        y_j = jnp.asarray(rng.integers(0, 2, n), jnp.float32)
+        margin = jnp.zeros(n, jnp.float32)
+        v_dummy = jnp.zeros((1, 4), jnp.uint8)
+        return (d_bins, y_j, None, jnp.ones(n, jnp.float32), margin,
+                margin, v_dummy, jnp.zeros(1, jnp.float32),
+                jnp.zeros(1, jnp.float32), jax.random.PRNGKey(0),
+                jnp.asarray(0, jnp.int32))
+
+    return [Case("first-chunk", fn, args()),
+            Case("next-chunk", fn, args())]

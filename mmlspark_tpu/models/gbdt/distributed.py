@@ -185,3 +185,76 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
         ingest=ingest, init_margin=init_margin, init_rng_key=init_rng_key,
         iter_offset=iter_offset)
     return booster, base, hist
+
+
+# -------------------------------------------------- semantic contracts
+# Registered in analysis/semantic/registry.py: the shard_map'd tree
+# grower and fused chunk lowered on the canonical 8-device analysis
+# mesh — the per-level histogram psum must appear as all-reduce traffic
+# inside the declared budget, and NOTHING else (a GSPMD all-gather here
+# would ride ICI on every tree of every fit).
+from ...analysis.semantic import Case, hot_path_contract  # noqa: E402
+
+
+def _contract_mesh():
+    return data_mesh()
+
+
+def _contract_rows(n: int, f: int):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    return (jnp.asarray(rng.integers(0, 16, (n, f)), jnp.uint8),
+            jnp.asarray(rng.normal(size=n), jnp.float32),
+            jnp.asarray(rng.uniform(0.1, 1.0, n), jnp.float32))
+
+
+@hot_path_contract(
+    "gbdt.tree.distributed",
+    expected_executables=1,
+    donate_expected=(),
+    # the measured 64x4x16 lowering on the 8-device mesh psums 7
+    # all-reduce ops / 1620 B (per-level histogram triples + split
+    # bookkeeping); budgets are those maxima with ~2x headroom
+    collective_budget={"all-reduce": {"ops": 14, "bytes": 4_000}},
+)
+def gbdt_tree_distributed_contract():
+    """Two identical-layout lowerings of the distributed tree grower."""
+    import jax.numpy as jnp
+    mesh = _contract_mesh()
+    cfg = trainer.TreeConfig(n_features=4, n_bins=16, max_depth=2,
+                             num_leaves=7, min_data_in_leaf=1)
+    fn = _compiled_tree_fn(mesh, cfg, None).fn
+    bins, grad, hess = _contract_rows(64, 4)
+    args = (bins, grad, hess, jnp.ones(4, bool), jnp.ones(64, jnp.float32))
+    return [Case("first-tree", fn, args), Case("next-tree", fn, args)]
+
+
+@hot_path_contract(
+    "gbdt.chunk.distributed",
+    expected_executables=1,
+    donate_expected=(),
+    # the measured chunk_len=2 lowering psums 7 all-reduce ops /
+    # 1620 B (the scan body compiles ONCE, so per-level psums do not
+    # multiply by iteration count); maxima with ~2x headroom
+    collective_budget={"all-reduce": {"ops": 14, "bytes": 4_000}},
+)
+def gbdt_chunk_distributed_contract():
+    """The distributed fused chunk on the canonical analysis mesh."""
+    import jax.numpy as jnp
+    from .boosting import BoostParams
+    mesh = _contract_mesh()
+    p = BoostParams(objective="binary", num_iterations=2, num_leaves=7,
+                    max_depth=2, max_bin=15, min_data_in_leaf=1)
+    cfg = trainer.TreeConfig(n_features=4, n_bins=16, max_depth=2,
+                             num_leaves=7, learning_rate=p.learning_rate,
+                             min_data_in_leaf=1)
+    fn = _compiled_chunk_fn(mesh, p, cfg, 2, 1, False, False, None).fn
+    bins, _, _ = _contract_rows(64, 4)
+    rng = np.random.default_rng(1)
+    y_j = jnp.asarray(rng.integers(0, 2, 64), jnp.float32)
+    margin = jnp.zeros(64, jnp.float32)
+    args = (bins, y_j, None, jnp.ones(64, jnp.float32), margin, margin,
+            jnp.zeros((1, 4), jnp.uint8), jnp.zeros(1, jnp.float32),
+            jnp.zeros(1, jnp.float32), jax.random.PRNGKey(0),
+            jnp.asarray(0, jnp.int32))
+    return [Case("first-chunk", fn, args), Case("next-chunk", fn, args)]

@@ -118,3 +118,37 @@ def _should_use_pallas(n_nodes: int) -> bool:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+# --------------------------------------------------- semantic contract
+# Registered in analysis/semantic/registry.py: the histogram build at a
+# canonical routed shape. On CPU this lowers the XLA scatter route (the
+# Pallas routes need a TPU) — degraded but non-vacuous: identity,
+# host-sync, and the zero-collective budget still bind the program the
+# tier-1 backend actually compiles.
+from ..analysis.semantic import Case, hot_path_contract  # noqa: E402
+
+
+@hot_path_contract(
+    "gbdt.hist.kernel",
+    expected_executables=1,
+    donate_expected=(),
+    collective_budget={},        # node-local histograms: the psum lives
+                                 # in the distributed tree contract
+)
+def gbdt_hist_route_contract():
+    import functools as _ft
+
+    import jax.numpy as jnp
+    import numpy as _np
+
+    fn = _ft.partial(node_feature_histograms, n_nodes=8, n_bins=16)
+    rng = _np.random.default_rng(0)
+
+    def args():
+        return (jnp.asarray(rng.integers(0, 16, (256, 4)), jnp.uint8),
+                jnp.asarray(rng.normal(size=256), jnp.float32),
+                jnp.asarray(rng.uniform(0.1, 1.0, 256), jnp.float32),
+                jnp.asarray(rng.integers(0, 8, 256), jnp.int32),
+                jnp.ones(256, bool))
+    return [Case("level-0", fn, args()), Case("level-1", fn, args())]

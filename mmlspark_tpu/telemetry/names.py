@@ -173,6 +173,8 @@ COUNTERS = {
 }
 
 # ----------------------------------------------------------------- gauges
+ANALYSIS_SEMANTIC_CONTRACTS = "analysis.semantic.contracts"
+ANALYSIS_SEMANTIC_FINDINGS = "analysis.semantic.findings"
 GBDT_HIST_PLAN_BYTES = "gbdt.hist.plan.bytes"
 SERVING_QUEUE_DEPTH = "serving.queue_depth"
 SERVING_BATCH_OCCUPANCY = "serving.batch.occupancy"
@@ -190,6 +192,10 @@ TELEMETRY_WATCH_TRIPPED = "telemetry.watch.tripped"
 QUALITY_DRIFT_MAX = "quality.drift.max"
 
 GAUGES = {
+    ANALYSIS_SEMANTIC_CONTRACTS: "hot-path contracts analyzed by the last "
+                                 "semantic-tier run",
+    ANALYSIS_SEMANTIC_FINDINGS: "findings (incl. contract-import errors) "
+                                "from the last semantic-tier run",
     GBDT_HIST_PLAN_BYTES: "resident level-invariant one-hot plane bytes "
                           "built for the current fit "
                           "(MMLSPARK_TPU_HIST=planes)",

@@ -56,6 +56,7 @@ future harness computes it the same way.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -254,6 +255,38 @@ def collective_traffic(hlo_text: str) -> dict:
     return out
 
 
+_ALIAS_PARAM_RE = re.compile(r"\(\s*(\d+)\s*,")
+_MODULE_NAME_RE = re.compile(r"^HloModule [^,\n]*")
+
+
+def donation_aliases(hlo_text: str) -> tuple:
+    """Flattened parameter numbers donated to outputs, parsed from the
+    compiled module header's `input_output_alias={ {0}: (1, {}, ...) }`
+    (each entry is `{output}: (param, {param_index}[, kind])`). Returns
+    () when the module has no aliasing — shared by the perf ledger and
+    the semantic analyzer's donation checker."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return ()
+    i = start + len("input_output_alias={")
+    depth, j = 1, i
+    while j < len(hlo_text) and depth:
+        depth += {"{": 1, "}": -1}.get(hlo_text[j], 0)
+        j += 1
+    return tuple(sorted({int(m) for m in
+                         _ALIAS_PARAM_RE.findall(hlo_text[i:j - 1])}))
+
+
+def hlo_fingerprint(hlo_text: str) -> str:
+    """Content hash of an HLO/StableHLO module with the (arbitrary)
+    module name normalized away — two lowerings are THE SAME executable
+    iff their fingerprints match (the semantic executable-identity
+    checker's unit of comparison)."""
+    return hashlib.sha1(
+        _MODULE_NAME_RE.sub("HloModule m", hlo_text,
+                            count=1).encode()).hexdigest()
+
+
 # ------------------------------------------------------ executable analysis
 _COST_FIELDS = (("flops", "flops"),
                 ("bytes accessed", "bytes_accessed"),
@@ -373,6 +406,13 @@ class AotCache:
         self._lock = threading.Lock()
         self._compiled: OrderedDict = OrderedDict()
         self._jitted = None
+
+    @property
+    def fn(self):
+        """The wrapped (un-jitted) step function — the semantic analyzer
+        lowers the SAME callable the cache compiles, so its contract
+        checks cover the executable that actually runs."""
+        return self._fn
 
     @staticmethod
     def _sig(args) -> tuple:

@@ -32,7 +32,8 @@ from mmlspark_tpu.analysis.checkers.markers import PytestMarkerRule
 from mmlspark_tpu.analysis.checkers.names import (MetricKindCollisionRule,
                                                   MetricNameRule,
                                                   MetricNameUndocumentedRule)
-from mmlspark_tpu.analysis.checkers.tracing import (TraceMutableClosureRule,
+from mmlspark_tpu.analysis.checkers.tracing import (TraceHostSyncRule,
+                                                    TraceMutableClosureRule,
                                                     TraceNumpyCallRule,
                                                     TracePythonBranchRule)
 
@@ -79,11 +80,12 @@ def test_repo_is_clean_under_strict():
 
 def test_cli_strict_exits_zero_on_shipped_tree():
     """The acceptance command itself, end to end through the console
-    entry point."""
+    entry point — BOTH tiers: the AST rules over the tree plus the
+    semantic tier lowering every registered hot-path contract."""
     proc = subprocess.run(
         [sys.executable, "-m", "mmlspark_tpu.analysis", "--strict",
-         "mmlspark_tpu", "tests"],
-        cwd=_REPO, capture_output=True, text=True, timeout=300,
+         "--all-tiers", "mmlspark_tpu", "tests"],
+        cwd=_REPO, capture_output=True, text=True, timeout=540,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 finding(s)" in proc.stdout, proc.stdout
@@ -319,6 +321,46 @@ def test_trace_mutable_closure_flagged(tmp_path):
     ok = src.format(disable="  # graftlint: disable=trace-mutable-closure")
     assert _lint(tmp_path / "b", {"pkg/mod.py": ok},
                  [TraceMutableClosureRule()]) == []
+
+
+def test_trace_host_sync_flagged_in_loop_bodies(tmp_path):
+    src = """
+    import jax
+    import numpy as np
+
+    def host(xs):
+        total = 0.0
+        for x in xs:
+            total += float(x)       # not traced: fine
+        return total
+
+    @jax.jit
+    def bad(xs):
+        out = 0.0
+        for i in range(3):
+            out = out + float(xs){d1}
+            arr = np.asarray(xs){d2}
+        while out < 10.0:
+            xs.block_until_ready(){d3}
+        return out
+
+    @jax.jit
+    def ok(xs):
+        return float(xs)            # outside any loop: one sync, fine
+    """
+    found = _lint(tmp_path / "a",
+                  {"pkg/mod.py": src.format(d1="", d2="", d3="")},
+                  [TraceHostSyncRule()])
+    kinds = sorted(f.message.split("`")[1] for f in found)
+    assert kinds == [".block_until_ready()", "float(...)",
+                     "np.asarray(...)"], found
+    assert all("EVERY iteration" in f.message for f in found)
+    ok = src.format(
+        d1="  # graftlint: disable=trace-host-sync",
+        d2="  # graftlint: disable=trace-host-sync",
+        d3="  # graftlint: disable=trace-host-sync")
+    assert _lint(tmp_path / "b", {"pkg/mod.py": ok},
+                 [TraceHostSyncRule()]) == []
 
 
 # --------------------------------------------------------- 3. determinism
@@ -734,7 +776,8 @@ def test_default_rules_cover_the_six_checkers():
     names = {r.name for r in default_rules()}
     for expected in ("lock-blocking-call", "lock-order-cycle",
                      "trace-python-branch", "trace-numpy-call",
-                     "trace-mutable-closure", "wall-clock",
+                     "trace-mutable-closure", "trace-host-sync",
+                     "wall-clock",
                      "legacy-random", "set-iteration",
                      "metric-name-unknown", "metric-kind-collision",
                      "metric-name-undocumented", "fault-site-unknown",
