@@ -739,12 +739,17 @@ def _bench_serving():
         q.stop()
         server.stop()
 
+    # content-addressed version stamp (telemetry/lineage.py): benchdiff
+    # trajectories can then tell a perf regression from a model swap —
+    # same metric name, different model content, different version id
+    from mmlspark_tpu.telemetry.lineage import model_version
     print(json.dumps({
         "metric": "serving_gbdt_model_req_per_sec",
         "value": out["coalesced_req_per_sec"], "unit": "req/s",
         # reference bar: 5k req/s sustained (docs/mmlspark-serving.md)
         "vs_baseline": round(out["coalesced_req_per_sec"] / 5000.0, 3),
         "model": "GBDTClassifier 20 trees depth<=5, 16 features",
+        "model_version": model_version(model).version,
         **out}))
 
 

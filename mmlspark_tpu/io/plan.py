@@ -52,16 +52,32 @@ from ..telemetry import names as tnames
 from ..telemetry import perf as tperf
 from ..telemetry import quality as tquality
 from ..utils import tracing
+from ..utils.checkpoint import array_sha256
 from .serving import Reply, _jsonable
 
 
-def pipeline_fingerprint(stage) -> str:
-    """Stable hex digest of a (possibly nested) fitted stage: class,
-    non-transient params, and fitted-state array shapes/dtypes. Cheap by
-    design — array CONTENTS are not hashed. Within one `ServingTransform`
-    the model is fixed, so this mainly makes cache keys self-describing;
-    it is what lets plan keys stay collision-free if the cache is ever
-    shared across transforms/processes or a served model is hot-swapped."""
+def pipeline_fingerprint(stage, content: bool = False) -> str:
+    """Stable hex digest of a (possibly nested) fitted stage.
+
+    Two-digest contract (deployment observability, docs/serving.md):
+
+    - `content=False` (default) — the STRUCTURAL digest: class,
+      non-transient params, and fitted-state array shapes/dtypes. Cheap
+      by design — array CONTENTS are not hashed. This is the lineage
+      "same architecture?" axis and the plan-cache fallback when content
+      digesting is disabled (`version_content=False`) — in that mode the
+      caller asserts one model per structure, because plan closures
+      capture the fitted arrays.
+    - `content=True` — the CONTENT digest: the same walk, but every
+      fitted array's bytes are hashed (`utils.checkpoint.array_sha256`,
+      dtype/shape-qualified). Two fits of the same architecture on
+      different data digest differently — this is what
+      `telemetry.lineage.model_version` builds ModelVersion identity
+      (and the `X-Model-Version` reply stamp) from, and what the
+      serving plan cache keys on, so a hot-swapped retrain never reuses
+      the incumbent's compiled closures. Costs one pass over the fitted
+      arrays; computed once per install, never per request.
+    """
     h = hashlib.sha1()
 
     def feed(s):
@@ -83,7 +99,10 @@ def pipeline_fingerprint(stage) -> str:
         for k in sorted(state):
             v = state[k]
             if isinstance(v, np.ndarray):
-                h.update(f"{k}:{v.dtype}{v.shape};".encode())
+                if content:
+                    h.update(f"{k}:{array_sha256(v)};".encode())
+                else:
+                    h.update(f"{k}:{v.dtype}{v.shape};".encode())
             else:
                 h.update(f"{k}={v!r};".encode())
     feed(stage)
@@ -114,6 +133,32 @@ def _decode_rows(bodies: Sequence[bytes], input_cols: Sequence[str]):
     return rows, replies
 
 
+class _ModelHandle:
+    """One served model version: the model, its resolved row kernel, the
+    content-qualified plan-cache fingerprint, and the ModelVersion id
+    replies are stamped with. IMMUTABLE — `install_model` swaps the whole
+    handle with a single attribute assignment (atomic under the GIL), so a
+    worker that read the handle at batch start resolves its plan, runs
+    its closures, and stamps its version all from ONE consistent model:
+    in-flight requests are answered by the version that dequeued them,
+    never a fingerprint/closure mix of old and new.
+
+    The fingerprint prefers the CONTENT digest when the transform computed
+    one: plan closures capture the fitted model's arrays, so two fits of
+    the same architecture must NOT share cache entries — a hot-swapped
+    retrain would otherwise be scored by the incumbent's captured kernel
+    while stamping the new version on the reply."""
+
+    __slots__ = ("model", "kernel", "fingerprint", "version", "mv")
+
+    def __init__(self, model, kernel, mv):
+        self.model = model
+        self.kernel = kernel
+        self.mv = mv                      # the full ModelVersion record
+        self.fingerprint = mv.content_digest or mv.fingerprint
+        self.version = mv.version
+
+
 class ServingTransform:
     """The compiled `bodies -> replies` transform `serve_pipeline` mounts.
 
@@ -129,30 +174,28 @@ class ServingTransform:
     feeds the live sketches + the delayed-label join — head-sampled by
     request id, a no-op boolean test when no profile is installed.
     `wants_request_ids` tells the serving worker to pass each row's
-    request id (== `X-Request-Id` == trace id), the label-join key."""
+    request id (== `X-Request-Id` == trace id), the label-join key.
+
+    **Versioned handle + hot-swap** (telemetry/lineage.py): the served
+    model lives in an immutable `_ModelHandle`; `install_model(model)`
+    builds a fresh handle off-path and commits it atomically — zero
+    dropped requests, old plans DRAIN out of the LRU (never
+    invalidated), every reply stamped `X-Model-Version` with the version
+    that scored it, and the version registry keeps per-version
+    latency/error splits for `/versions` and the canary gauges."""
 
     wants_request_ids = True
 
     def __init__(self, model, input_cols: Sequence[str],
                  output_col: str = "prediction", max_bucket: int = 4096,
-                 metrics=None, max_plans: int = 64):
-        # a single-stage PipelineModel serves through its one stage — the
-        # wrapper adds nothing and would hide the stage's serving kernel
-        stages = (model.get_or_default("stages")
-                  if isinstance(model, PipelineModel) else None)
-        self.model = stages[0] if stages is not None and len(stages) == 1 \
-            else model
+                 metrics=None, max_plans: int = 64, faults=None,
+                 version_content: bool = True):
         self.input_cols = list(input_cols)
         self.output_col = output_col
         self.max_bucket = max_bucket
         self._metrics = metrics if metrics is not None else reliability_metrics
-        self.fingerprint = pipeline_fingerprint(self.model)
-        # the row kernel consumes ONE features matrix; multi-column inputs
-        # go through the generic Table path
-        kernel_of = getattr(self.model, "_serving_kernel", None)
-        self._kernel = (kernel_of(output_col)
-                        if kernel_of is not None and len(self.input_cols) == 1
-                        else None)
+        self._faults = faults
+        self._version_content = version_content
         # bounded LRU: power-of-two bucketing keeps the steady-state key
         # count logarithmic, but a cache shared across hot-swapped model
         # versions (ROADMAP item 5) or fed adversarial batch sizes must
@@ -175,16 +218,89 @@ class ServingTransform:
         # per-row value between these fragments
         self._prefix = ('{"%s": ' % output_col).encode()
         self._suffix = b"}"
+        self._handle = self._make_handle(model)
+        self._register_version(self._handle)
+        self._install_profile(self._handle)
+
+    # -- versioned handle ----------------------------------------------------
+    @property
+    def model(self):
+        return self._handle.model
+
+    @property
+    def fingerprint(self) -> str:
+        return self._handle.fingerprint
+
+    @property
+    def version(self) -> Optional[str]:
+        return self._handle.version
+
+    def _make_handle(self, model) -> _ModelHandle:
+        # a single-stage PipelineModel serves through its one stage — the
+        # wrapper adds nothing and would hide the stage's serving kernel
+        stages = (model.get_or_default("stages")
+                  if isinstance(model, PipelineModel) else None)
+        model = stages[0] if stages is not None and len(stages) == 1 \
+            else model
+        # the row kernel consumes ONE features matrix; multi-column inputs
+        # go through the generic Table path
+        kernel_of = getattr(model, "_serving_kernel", None)
+        kernel = (kernel_of(self.output_col)
+                  if kernel_of is not None and len(self.input_cols) == 1
+                  else None)
+        from ..telemetry import lineage as tlineage
+        mv = tlineage.model_version(model, content=self._version_content)
+        return _ModelHandle(model, kernel, mv)
+
+    def _register_version(self, handle: _ModelHandle) -> dict:
+        from ..telemetry import lineage as tlineage
+        return tlineage.get_version_registry().install(
+            handle.mv, metrics=self._metrics)
+
+    @staticmethod
+    def _install_profile(handle: _ModelHandle) -> None:
         # reference-profile install: the model's frozen fit-time profile
         # becomes the process quality reference (last served model wins —
         # multi-model tenancy is ROADMAP item 3 stretch). Guarded: a
         # malformed profile loses quality observability, never serving.
-        profile = getattr(self.model, "quality_profile", None)
+        # `set_reference` also CLEARS the previous model's stale
+        # quality.drift.* gauges, so a hot-swap never reports the old
+        # version's drift as the new one's.
+        profile = getattr(handle.model, "quality_profile", None)
         if profile:
             try:
                 tquality.get_monitor().set_reference(profile)
             except Exception:  # noqa: BLE001
                 pass
+
+    def install_model(self, model) -> dict:
+        """Zero-downtime hot-swap: build the new version's handle fully
+        OFF the request path, then commit it with one atomic assignment.
+        Workers mid-batch finish on the handle they already read (old
+        plans drain via the LRU, never invalidated — `plan.recompiles`
+        stays 0 for the incumbent's keys); the next batch they dequeue
+        reads the new handle. A failure anywhere before the commit —
+        including the seeded `serving.swap` chaos site — leaves the
+        incumbent serving untouched (`serving.model.swap_errors`) and
+        re-raises to the caller. Returns {"old": id|None, "new": id}."""
+        try:
+            new = self._make_handle(model)
+            if self._faults is not None:
+                self._faults.perturb("serving.swap")
+            # registry install FIRST: freezing the incumbent's canary
+            # baseline must read the OLD reference's live drift, so it
+            # happens before the new profile swaps the quality reference
+            swap = self._register_version(new)
+        except Exception:
+            self._metrics.inc(tnames.SERVING_MODEL_SWAP_ERRORS)
+            raise
+        self._install_profile(new)
+        self._handle = new   # the commit point (atomic attribute swap)
+        self._metrics.inc(tnames.SERVING_MODEL_SWAPS)
+        get_tracer().event(tnames.SERVING_MODEL_SWAP_EVENT,
+                           old=swap.get("old"), new=swap.get("new"),
+                           plans=len(self._plans))
+        return swap
 
     # -- plan construction ---------------------------------------------------
     # A plan is an (assemble, run) pair: `assemble` converts parsed rows to
@@ -192,10 +308,10 @@ class ServingTransform:
     # wrong type/width) and maps to a per-row 400; `run` executes the model
     # — failures there are server-side and propagate to the worker's
     # replay/502 machinery, never misreported as the client's fault.
-    def _build_plan(self, bucket: int):
+    def _build_plan(self, bucket: int, handle: _ModelHandle):
         cols = self.input_cols
-        if self._kernel is not None:
-            kernel = self._kernel
+        if handle.kernel is not None:
+            kernel = handle.kernel
             col = cols[0]
             width = getattr(kernel, "expected_features", None)
 
@@ -211,7 +327,7 @@ class ServingTransform:
             # needed — the bucket key only serves the hit accounting
             return assemble, kernel
 
-        model, out_col = self.model, self.output_col
+        model, out_col = handle.model, self.output_col
 
         def assemble(rows: list) -> dict:
             data = {}
@@ -231,8 +347,14 @@ class ServingTransform:
             return np.asarray(out[out_col])[:n]
         return assemble, run
 
-    def _plan_for(self, n_rows: int) -> tuple:
-        """Resolve (or build) the plan for this batch size.
+    def _plan_for(self, n_rows: int,
+                  handle: Optional[_ModelHandle] = None) -> tuple:
+        """Resolve (or build) the plan for this batch size, for THIS
+        handle: keying and closure construction both read the handle the
+        caller captured at batch start, so a hot-swap racing a build can
+        never cache the new model's closures under the old fingerprint.
+        (`handle=None` reads the currently served handle — the direct
+        plan-inspection path tests use.)
 
         Miss-stampede contract: when N worker threads miss the same
         (fingerprint, bucket) concurrently, exactly ONE builds —
@@ -241,8 +363,10 @@ class ServingTransform:
         Waiters block on the builder's Event and count as hits (they got
         a plan without compiling). A builder that fails clears its Event
         so a waiter retries the build rather than caching the failure."""
+        if handle is None:
+            handle = self._handle
         bucket = shape_bucket(n_rows, self.max_bucket)
-        key = (self.fingerprint, bucket)
+        key = (handle.fingerprint, bucket)
         while True:
             with self._lock:
                 plan = self._plans.get(key)
@@ -263,7 +387,7 @@ class ServingTransform:
                 continue
             t0 = time.perf_counter()
             try:
-                built = self._build_plan(bucket)
+                built = self._build_plan(bucket, handle)
             except BaseException:
                 with self._lock:
                     self._building.pop(key).set()   # wake waiters to retry
@@ -288,32 +412,47 @@ class ServingTransform:
             # pressure, or bucketing gone wrong) counts plan.recompiles,
             # which steady-state serving pins to zero
             tperf.record_plan_compile(
-                self.fingerprint, bucket, build_s,
+                handle.fingerprint, bucket, build_s,
                 analysis={"rows_bucket": bucket,
                           "input_cols": len(self.input_cols),
-                          "kind": ("host-kernel" if self._kernel is not None
+                          "kind": ("host-kernel" if handle.kernel is not None
                                    else "table-transform")},
-                label=type(self.model).__name__,
+                label=type(handle.model).__name__,
                 registry=(None if self._metrics is reliability_metrics
                           else self._metrics))
             return built
 
     def stats(self) -> dict:
+        fp = self._handle.fingerprint
         with self._lock:
+            # stale = plans keyed by a superseded handle's fingerprint:
+            # they DRAIN (LRU pressure from the new version's traffic
+            # evicts them) — `stale_plans -> 0` is the hot-swap test's
+            # drain assertion
+            stale = sum(1 for (f, _b) in self._plans if f != fp)
             return {"hits": self._hits, "misses": self._misses,
                     "buckets": len(self._plans),
                     "evictions": self._evictions,
-                    "capacity": self.max_plans}
+                    "capacity": self.max_plans,
+                    "stale_plans": stale}
 
     # -- the transform -------------------------------------------------------
     def __call__(self, bodies: Sequence[bytes],
                  request_ids: Optional[Sequence[str]] = None) -> list:
+        # ONE handle read per batch: plan keying, closure execution, and
+        # the version stamp all come from it — a hot-swap committing
+        # mid-batch changes none of this batch's behavior
+        handle = self._handle
         rows, replies = _decode_rows(bodies, self.input_cols)
+        if handle.version is not None:
+            for i, r in enumerate(replies):
+                if r is not None:
+                    replies[i] = r._replace(version=handle.version)
         good_idx = [i for i, r in enumerate(rows) if r is not None]
         if not good_idx:
             return replies
         good_rows = [rows[i] for i in good_idx]
-        assemble, run = self._plan_for(len(good_rows))
+        assemble, run = self._plan_for(len(good_rows), handle)
         try:
             data = assemble(good_rows)
         except (ValueError, TypeError):
@@ -327,7 +466,7 @@ class ServingTransform:
                     survivors.append((i, row, assemble([row])))
                 except (ValueError, TypeError) as e:
                     replies[i] = Reply({"error": f"bad request: {e}"},
-                                       status=400)
+                                       status=400, version=handle.version)
             if not survivors:
                 return replies
             good_idx = [i for i, _, _ in survivors]
@@ -340,25 +479,39 @@ class ServingTransform:
                 # answered and nothing rides the replay machinery for
                 # what is client-shaped data
                 for i, _, single in survivors:
-                    self._run_rows([i], single, run, replies, request_ids)
+                    self._run_rows([i], single, run, replies, request_ids,
+                                   handle)
                 return replies
-        self._run_rows(good_idx, data, run, replies, request_ids)
+        self._run_rows(good_idx, data, run, replies, request_ids, handle)
         return replies
 
     def _run_rows(self, good_idx: list, data, run, replies: list,
-                  request_ids: Optional[Sequence[str]] = None) -> None:
+                  request_ids: Optional[Sequence[str]] = None,
+                  handle: Optional[_ModelHandle] = None) -> None:
         """Execute the plan and encode one reply per row. Exceptions from
         `run` are SERVER faults and propagate to the worker's replay/502
-        machinery untouched. The span joins the ambient request trace the
-        serving worker activated (no-op when the batch is unsampled)."""
-        with get_tracer().span(tnames.SERVING_PLAN_RUN_SPAN,
-                               rows=len(good_idx)):
-            # the span times the batch; the annotation names the region
-            # on captured device profiles and notes its host wall into
-            # the roofline ledger (telemetry/profiler.py) — a triggered
-            # /debug/profile capture attributes serving device time here
-            with tracing.annotate(tnames.SERVING_PLAN_RUN_SPAN):
-                vals = np.asarray(run(data))
+        machinery untouched (counted into the scoring version's split
+        first — the canary's error-burn numerator). The span joins the
+        ambient request trace the serving worker activated (no-op when
+        the batch is unsampled)."""
+        handle = handle if handle is not None else self._handle
+        t0 = time.perf_counter()
+        try:
+            with get_tracer().span(tnames.SERVING_PLAN_RUN_SPAN,
+                                   rows=len(good_idx)):
+                # the span times the batch; the annotation names the
+                # region on captured device profiles and notes its host
+                # wall into the roofline ledger (telemetry/profiler.py)
+                # — a triggered /debug/profile capture attributes
+                # serving device time here
+                with tracing.annotate(tnames.SERVING_PLAN_RUN_SPAN):
+                    vals = np.asarray(run(data))
+        except BaseException:
+            self._observe_version(handle, None, rows=len(good_idx),
+                                  errors=len(good_idx))
+            raise
+        self._observe_version(handle, (time.perf_counter() - t0) * 1000.0,
+                              rows=len(good_idx))
         # model-quality tap: live distribution sketches + the delayed-
         # label join (telemetry/quality.py). One boolean test when no
         # reference profile is installed; head-sampled by request id
@@ -368,6 +521,7 @@ class ServingTransform:
             None if request_ids is None
             else [request_ids[i] for i in good_idx])
         prefix, suffix = self._prefix, self._suffix
+        ver = handle.version
         if vals.ndim == 1 and vals.dtype.kind == "f":
             # scalar-float fast path: Python float repr IS shortest
             # round-trip JSON for finite values — skips json.dumps per
@@ -377,26 +531,43 @@ class ServingTransform:
                 enc = (repr(v) if math.isfinite(v)
                        else json.dumps(v)).encode()
                 replies[i] = Reply(prefix + enc + suffix,
-                                   content_type="application/json")
+                                   content_type="application/json",
+                                   version=ver)
         else:
             for i, v in zip(good_idx, vals):
-                replies[i] = self._encode(v)
+                replies[i] = self._encode(v, ver)
 
-    def _encode(self, v) -> Reply:
+    def _observe_version(self, handle: _ModelHandle, ms, rows: int = 1,
+                         errors: int = 0) -> None:
+        """Fold this batch into the scoring version's split registry —
+        guarded: version accounting never fails a request."""
+        if handle.version is None:
+            return
+        try:
+            from ..telemetry import lineage as tlineage
+            tlineage.get_version_registry().observe(
+                handle.version, ms, rows=rows, errors=errors)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _encode(self, v, version: Optional[str] = None) -> Reply:
         return Reply(
             self._prefix + json.dumps(_jsonable(v)).encode() + self._suffix,
-            content_type="application/json")
+            content_type="application/json", version=version)
 
 
 def compile_serving_transform(model, input_cols: Sequence[str],
                               output_col: str = "prediction",
                               max_bucket: int = 4096,
-                              max_plans: int = 64) -> ServingTransform:
+                              max_plans: int = 64,
+                              faults=None) -> ServingTransform:
     """Build the compiled serving transform for a fitted model/pipeline.
     See module docstring; `serve_pipeline(fast_path=True)` calls this.
-    `max_plans` bounds the LRU plan cache (`serving.plan.evictions`)."""
+    `max_plans` bounds the LRU plan cache (`serving.plan.evictions`);
+    `faults` arms the `serving.swap` chaos site on `install_model`."""
     return ServingTransform(model, input_cols, output_col,
-                            max_bucket=max_bucket, max_plans=max_plans)
+                            max_bucket=max_bucket, max_plans=max_plans,
+                            faults=faults)
 
 
 # --------------------------------------------------- semantic contract

@@ -39,13 +39,23 @@ class ServiceInfo(NamedTuple):
     metrics/slo exposition surface, `telemetry.exposition.expose_trainer`)
     — so `scrape_cluster`/`TelemetryPoller` can target one class without
     probing. Wire compat: a ``"serving"`` register omits the field (the
-    pre-kind body byte-for-byte) and a missing field parses as serving."""
+    pre-kind body byte-for-byte) and a missing field parses as serving.
+
+    `version` is the model version id the worker was serving when it
+    registered (`ServingTransform.version`, telemetry/lineage.py) — the
+    coarse rollout map: `scrape_cluster(versions=True, slo=True)` groups
+    worker SLO verdicts by it (`slo_by_version`). It is a REGISTRATION
+    snapshot, not live state — a hot-swap after registration shows in
+    `/versions`, not here. Same wire contract as `kind`: None omits the
+    field (version-less body byte-for-byte) and a missing field parses
+    as None."""
     name: str
     host: str
     port: int
     process_id: int
     num_partitions: int
     kind: str = "serving"
+    version: Optional[str] = None
 
     @property
     def address(self) -> str:
@@ -171,7 +181,8 @@ def report_server_to_registry(registry_address: str, name: str, host: str,
                               num_partitions: int = 1,
                               timeout: float = 10.0,
                               retry_policy: Optional[RetryPolicy] = None,
-                              kind: str = "serving") -> None:
+                              kind: str = "serving",
+                              version: Optional[str] = None) -> None:
     """Worker-side report (WorkerClient.reportServerToDriver,
     HTTPSourceV2.scala:460-468).
 
@@ -186,12 +197,17 @@ def report_server_to_registry(registry_address: str, name: str, host: str,
         metric_name=tnames.REGISTRY_REPORT_RETRIES)
     info = ServiceInfo(name=name, host=host, port=port,
                        process_id=process_id,
-                       num_partitions=num_partitions, kind=kind)
+                       num_partitions=num_partitions, kind=kind,
+                       version=version)
     body = info._asdict()
     if body["kind"] == "serving":
         # wire compat (the satellite contract): the default kind posts
         # the pre-kind body byte-for-byte; only trainers say so
         body.pop("kind")
+    if body["version"] is None:
+        # same contract for version: an unversioned register posts the
+        # pre-version body byte-for-byte
+        body.pop("version")
     data = json.dumps(body).encode()
     last_err: Optional[Exception] = None
     headers = get_tracer().inject({"Content-Type": "application/json"})
@@ -431,8 +447,11 @@ def start_distributed_serving(transform_fn, name: str = "serving",
                            num_partitions=num_partitions).start()
     query = ServingQuery(server, transform_fn, mode=mode).start()
     s_port = server._httpd.server_address[1]
+    # a compiled ServingTransform carries its model-version id — register
+    # it so the fleet's rollout map starts from the registry itself
     report_server_to_registry(registry_address, name, pub_host, s_port,
-                              process_id=pid, num_partitions=num_partitions)
+                              process_id=pid, num_partitions=num_partitions,
+                              version=getattr(transform_fn, "version", None))
     if drain_on_sigterm:
         # preempted hosts answer their in-flight requests before exiting
         # (serving.drain_on_signal; the leader also takes its registry down)

@@ -57,6 +57,9 @@ class Reply(NamedTuple):
     data: object
     status: int = 200
     content_type: Optional[str] = None
+    # the ModelVersion id that scored this row (io/plan.py versioned
+    # handle); rides out as the X-Model-Version response header
+    version: Optional[str] = None
 
 
 # request-id source: a process-unique counter under a random run prefix.
@@ -70,7 +73,7 @@ class CachedRequest:
     """One held HTTP exchange (reference: CachedRequest, HTTPSourceV2.scala:519)."""
 
     __slots__ = ("id", "body", "headers", "path", "_event", "_response",
-                 "_on_respond", "t_enqueue", "span", "slo")
+                 "_on_respond", "t_enqueue", "span", "slo", "version")
 
     def __init__(self, body: bytes, headers: dict, path: str,
                  on_respond=None):
@@ -85,6 +88,7 @@ class CachedRequest:
         self.span = None                # ingress root span (telemetry)
         self.slo = False                # counted in serving.request.*
         #                                 (exposition self-scrapes are not)
+        self.version = None             # X-Model-Version response stamp
 
     def respond(self, status: int, body: bytes,
                 content_type: str = "application/json"):
@@ -166,6 +170,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         # client-visible correlation id == server-side root span id
         self.send_header("X-Request-Id", cached.id)
+        if cached.version is not None:
+            # which ModelVersion answered (hot-swap attribution)
+            self.send_header("X-Model-Version", cached.version)
         self.end_headers()
         self.wfile.write(payload)
 
@@ -212,9 +219,12 @@ _REASONS = {200: "OK", 400: "Bad Request", 413: "Payload Too Large",
 # is rate-limited and ms-clamped. /quality is the model-quality export
 # (telemetry/quality.py): reference/live sketch states, drift rows, and
 # streaming-eval state — scrape_cluster(quality=True) merges it
-# fleet-wide.
+# fleet-wide. /versions is the deployment-observability export
+# (telemetry/lineage.py): tracked ModelVersions' lineage, per-version
+# latency/error splits, and the candidate-vs-incumbent canary values —
+# scrape_cluster(versions=True) merges it and tracks rollout skew.
 EXPOSITION_PATHS = ("/metrics", "/metrics.json", "/slo", "/quality",
-                    "/debug/bundle", "/debug/profile")
+                    "/versions", "/debug/bundle", "/debug/profile")
 
 # Ingress bounds: a header block or body beyond these is rejected and the
 # connection closed — the single-threaded loop must never be wedged (or its
@@ -503,9 +513,16 @@ class _SelectorServer:
             status, payload, ctype = req._response
             out.append(_response_head(status, ctype))
             # X-Request-Id echoes the server-side correlation id (== the
-            # root span id) so the client can quote it against traces
-            out.append(b"%d\r\nX-Request-Id: %b\r\n\r\n"
-                       % (len(payload), req.id.encode("latin-1")))
+            # root span id) so the client can quote it against traces;
+            # X-Model-Version names the ModelVersion that answered
+            if req.version is not None:
+                out.append(
+                    b"%d\r\nX-Request-Id: %b\r\nX-Model-Version: %b\r\n\r\n"
+                    % (len(payload), req.id.encode("latin-1"),
+                       req.version.encode("latin-1")))
+            else:
+                out.append(b"%d\r\nX-Request-Id: %b\r\n\r\n"
+                           % (len(payload), req.id.encode("latin-1")))
             out.append(payload)
         if out:
             conn.wbuf += b"".join(out)
@@ -828,11 +845,14 @@ class ServingServer:
 
     # -- sink API -----------------------------------------------------------
     def reply_to(self, request_id: str, data, status: int = 200,
-                 content_type: Optional[str] = None):
+                 content_type: Optional[str] = None,
+                 version: Optional[str] = None):
         """Route a response to the held exchange (HTTPSourceV2.scala:535-553).
         `content_type` overrides the type inferred from `data` — the fast
         path hands over preserialized JSON bytes and must not label them
-        octet-stream."""
+        octet-stream. `version` stamps the reply's `X-Model-Version`
+        header: the ModelVersion that DEQUEUED and scored this request,
+        which a hot-swap mid-flight does not rewrite."""
         with self._lock:
             req = self._routing.get(request_id)
         if req is None:
@@ -843,6 +863,8 @@ class ServingServer:
             payload, ctype = data.encode(), "text/plain"
         else:
             payload, ctype = json.dumps(_jsonable(data)).encode(), "application/json"
+        if version is not None:
+            req.version = version
         req.respond(status, payload, content_type or ctype)
         return True
 
@@ -1001,7 +1023,8 @@ class ServingQuery:
     def _reply_one(self, r, reply):
         if isinstance(reply, Reply):
             self.server.reply_to(r.id, reply.data, status=reply.status,
-                                 content_type=reply.content_type)
+                                 content_type=reply.content_type,
+                                 version=reply.version)
         else:
             self.server.reply_to(r.id, reply)
 
@@ -1071,7 +1094,7 @@ def serve_pipeline(model, input_cols, output_col: str = "prediction",
                    host: str = "127.0.0.1", port: int = 0,
                    num_partitions: int = 1, mode: str = "microbatch",
                    max_batch: int = 64, batch_linger_ms: float = 0.0,
-                   fast_path: bool = True):
+                   fast_path: bool = True, faults=None):
     """One-call serving of a fitted PipelineModel: JSON rows in, scored
     column out (reference: the readStream.server().load() ->
     pipeline -> writeStream.server() composition, IOImplicits.scala).
@@ -1086,12 +1109,17 @@ def serve_pipeline(model, input_cols, output_col: str = "prediction",
     malformed JSON, preserialized reply framing. `fast_path=False` keeps
     the uncached Table-per-batch path — the pre-overhaul baseline
     BENCH_MODE=serving measures against. `batch_linger_ms` is the
-    microbatch coalescing budget (docs/serving.md "Latency tuning")."""
+    microbatch coalescing budget (docs/serving.md "Latency tuning").
+    `faults` arms the transform's `serving.swap` chaos site (a
+    mid-`install_model` fault rolls back to the incumbent); hot-swap a
+    retrained model with `query.transform_fn.install_model(new_model)`
+    — zero dropped requests (docs/serving.md "Hot-swap & canary")."""
     server = ServingServer(host, port, num_partitions).start()
 
     if fast_path:
         from .plan import compile_serving_transform
-        transform = compile_serving_transform(model, input_cols, output_col)
+        transform = compile_serving_transform(model, input_cols, output_col,
+                                              faults=faults)
     else:
         def transform(bodies: list) -> list:
             rows = [json.loads(b) for b in bodies]

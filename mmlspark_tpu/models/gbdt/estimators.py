@@ -378,6 +378,61 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             pass
         return model
 
+    def _attach_lineage(self, model):
+        """Stamp the fit's provenance onto the fitted model — the lineage
+        record `telemetry.lineage.model_version` freezes into the
+        content-addressed ModelVersion, so `/versions` can answer "what
+        trained the thing currently serving" without reaching back to the
+        training job. JSON-safe dict: estimator class + uid, the
+        non-transient Param snapshot, a digest of the frozen quality
+        reference profile (WHICH reference this version drifts against),
+        the resumable checkpoint step (checkpoint_dir fits), and the
+        fit's goodput/wall readout (telemetry.goodput.StepClock). Also
+        appended to the process RunLedger when one is configured.
+        Guarded: provenance must never fail a fit."""
+        try:
+            import hashlib
+            import json
+            params = {}
+            for pname, p in type(self).params().items():
+                if p.transient:
+                    continue
+                v = self.get_or_default(pname)
+                try:
+                    json.dumps(v)
+                    params[pname] = v
+                except (TypeError, ValueError):
+                    params[pname] = repr(v)
+            lineage = {"estimator": type(self).__name__, "uid": self.uid,
+                       "params": params}
+            prof = getattr(model, "quality_profile", None)
+            if prof is not None:
+                canon = json.dumps(prof, sort_keys=True, default=str)
+                lineage["reference_profile"] = hashlib.sha256(
+                    canon.encode()).hexdigest()[:12]
+            if self.checkpoint_dir:
+                from ...utils.checkpoint import CheckpointManager
+                step = CheckpointManager(self.checkpoint_dir).latest_step()
+                if step is not None:
+                    lineage["checkpoint_step"] = int(step)
+            from ...telemetry.goodput import get_clock
+            clock = get_clock()
+            if clock is not None:
+                snap = clock.snapshot()
+                lineage["fit"] = {
+                    k: snap.get(k)
+                    for k in ("steps", "wall_s", "goodput", "mfu")
+                    if snap.get(k) is not None}
+            model.lineage = lineage
+            from ...telemetry import lineage as tlineage
+            ledger = tlineage.get_run_ledger()
+            if ledger is not None:
+                ledger.append(
+                    tlineage.model_version(model, content=True).export())
+        except Exception:  # noqa: BLE001 - observability never fails a fit
+            pass
+        return model
+
 
 class _GBDTModelBase(Model, HasFeaturesCol, HasPredictionCol):
     """Shared scoring surface (reference: LightGBMModelMethods.scala)."""
@@ -470,7 +525,7 @@ class GBDTClassifier(Estimator, _GBDTParams, HasProbabilitiesCol):
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col,
             sigmoid=self.sigmoid)
-        return self._attach_quality_profile(table, m)
+        return self._attach_lineage(self._attach_quality_profile(table, m))
 
 
 class GBDTClassificationModel(_GBDTModelBase, HasProbabilitiesCol):
@@ -538,7 +593,7 @@ class GBDTRegressor(Estimator, _GBDTParams):
             features_col=self.features_col, prediction_col=self.prediction_col,
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col)
-        return self._attach_quality_profile(table, m)
+        return self._attach_lineage(self._attach_quality_profile(table, m))
 
 
 class GBDTRegressionModel(_GBDTModelBase):
@@ -579,7 +634,7 @@ class GBDTRanker(Estimator, _GBDTParams):
             features_col=self.features_col, prediction_col=self.prediction_col,
             leaf_prediction_col=self.leaf_prediction_col,
             features_shap_col=self.features_shap_col)
-        return self._attach_quality_profile(table, m)
+        return self._attach_lineage(self._attach_quality_profile(table, m))
 
 
 class GBDTRankerModel(_GBDTModelBase):

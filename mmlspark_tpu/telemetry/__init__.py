@@ -47,6 +47,13 @@ Pillars:
   `scrape_cluster(quality=True)`), and a delayed-label join feeding
   streaming evaluation through the batch `ComputeModelStatistics`
   metric kernels — the semantic tier over the systems telemetry.
+- **Lineage** (`telemetry.lineage`): content-addressed model versions
+  (structural + fitted-array digests) with fit-time provenance, the
+  bounded per-version metric splits behind `GET /versions`, the
+  candidate-vs-incumbent canary gauges (`canary.*`), rollout-skew from
+  `scrape_cluster(versions=True)`, and the append-only `RunLedger` —
+  deployment observability over the serving hot-swap
+  (`ServingTransform.install_model`).
 - **Hooks**: serving request path, `data.DevicePrefetcher`,
   `TrainingSupervisor` step/checkpoint lifecycle, `fit_booster`
   iterations, `utils.tracing.trace` device profiles (stamped with the
@@ -78,8 +85,15 @@ _LAZY_NAMES = {
     "WindowedCounter": "window", "WindowedHistogram": "window",
     "Objective": "slo", "SLOEngine": "slo", "default_objectives": "slo",
     "merge_verdicts": "slo", "trainer_objectives": "slo",
-    "quality_objectives": "slo",
+    "quality_objectives": "slo", "canary_objectives": "slo",
     "TelemetryPoller": "poller",
+    "ModelVersion": "lineage", "RunLedger": "lineage",
+    "model_version": "lineage", "configure_run_ledger": "lineage",
+    "get_run_ledger": "lineage",
+    "get_version_registry": "lineage", "reset_version_registry": "lineage",
+    "export_versions": "lineage", "merge_version_exports": "lineage",
+    "refresh_canary_gauges": "lineage", "rollout_skew": "lineage",
+    "canary_watch_rules": "lineage",
     "QualityMonitor": "quality", "DatasetProfile": "quality",
     "FeatureSketch": "quality", "StreamingEvaluator": "quality",
     "get_monitor": "quality", "reset_monitor": "quality",
@@ -124,8 +138,13 @@ __all__ = ["Tracer", "Span", "SpanContext", "get_tracer", "configure",
            "PROM_CONTENT_TYPE", "ExpositionServer", "expose_trainer",
            "WindowedHistogram", "WindowedCounter",
            "Objective", "SLOEngine", "default_objectives", "merge_verdicts",
-           "trainer_objectives", "quality_objectives",
+           "trainer_objectives", "quality_objectives", "canary_objectives",
            "TelemetryPoller",
+           "ModelVersion", "RunLedger", "model_version",
+           "configure_run_ledger", "get_run_ledger",
+           "get_version_registry", "reset_version_registry",
+           "export_versions", "merge_version_exports",
+           "refresh_canary_gauges", "rollout_skew", "canary_watch_rules",
            "QualityMonitor", "DatasetProfile", "FeatureSketch",
            "StreamingEvaluator", "get_monitor", "reset_monitor",
            "configure_quality", "export_quality", "refresh_quality_gauges",

@@ -271,8 +271,13 @@ def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
     ``"lower_better": true`` (MULTICHIP bubble/traffic synthesis) gates
     as lower-is-better without a CLI flag. Records whose ``backend``
     annotation is non-TPU are EXCLUDED from both the trajectory and the
-    gate (reported as excluded, so the omission is visible)."""
-    order: dict = {}   # metric -> [(label, value)] — dict keeps insertion order
+    gate (reported as excluded, so the omission is visible). A record's
+    ``model_version`` stamp (the serving bench carries the fitted
+    model's content-addressed id, telemetry/lineage.py) rides the
+    trajectory as ``label:value@version`` and annotates any regression
+    whose two compared rounds measured DIFFERENT versions — a model
+    swap and a perf regression must not read the same."""
+    order: dict = {}   # metric -> [(label, value, version)] — insertion order
     born_lower: set = set()
     excluded: list = []
     for label, by_metric in rounds:
@@ -284,17 +289,20 @@ def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
                 excluded.append(f"{label} {metric} "
                                 f"(backend={rec.get('backend')})")
                 continue
-            order.setdefault(metric, []).append((label, float(v)))
+            order.setdefault(metric, []).append(
+                (label, float(v), rec.get("model_version")))
             if rec.get("lower_better"):
                 born_lower.add(metric)
     lines: list = []
     regressions: list = []
     for metric, series in order.items():
-        traj = " -> ".join(f"{label}:{value:g}" for label, value in series)
+        traj = " -> ".join(
+            f"{label}:{value:g}" + (f"@{ver}" if ver else "")
+            for label, value, ver in series)
         if len(series) < 2:
             lines.append(f"{metric} [{key}]: {traj}  (single round)")
             continue
-        (_, prev), (_, last) = series[-2], series[-1]
+        (_, prev, pver), (_, last, lver) = series[-2], series[-1]
         if last == prev:
             delta = 0.0   # unchanged is unchanged, even from a 0 baseline
         elif prev:
@@ -307,10 +315,12 @@ def diff_rounds(rounds: List[Tuple[str, dict]], key: str = "value",
             lb = metric in lower_better or metric in born_lower
             drop = delta if lb else -delta
             if drop > threshold:
+                swap = (f", model_version {pver} -> {lver}"
+                        if pver and lver and pver != lver else "")
                 regressions.append(
                     f"{metric}: {prev:g} -> {last:g} "
                     f"({delta:+.1%}, threshold {threshold:.0%}"
-                    f"{', lower-better' if lb else ''})")
+                    f"{', lower-better' if lb else ''}{swap})")
     for note in excluded:
         lines.append(f"excluded from perf gates (non-TPU backend): {note}")
     return lines, regressions

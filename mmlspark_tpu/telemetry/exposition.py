@@ -218,9 +218,20 @@ def metrics_http_response(path: str, registry=None) -> tuple:
             refresh_quality_gauges(reg)
         except Exception:  # noqa: BLE001
             pass
+        # canary gauges refresh on the same cadence: the candidate-vs-
+        # incumbent comparison a canary objective or watch rule reads
+        # must reflect the splits as of THIS scrape. Same guard.
+        try:
+            from .lineage import refresh_canary_gauges
+            refresh_canary_gauges(reg)
+        except Exception:  # noqa: BLE001
+            pass
     if base == "/quality":
         from .quality import quality_http_response
         return quality_http_response()
+    if base == "/versions":
+        from .lineage import versions_http_response
+        return versions_http_response(window_s=window_s)
     if base == "/slo":
         from .slo import get_engine
         return 200, json.dumps(get_engine().verdict()).encode(), \
@@ -516,11 +527,15 @@ class ClusterSnapshot(NamedTuple):
     `/slo` verdict when the scrape asked for it (None otherwise);
     `quality` is the fleet-merged `/quality` export (sketch counts
     summed, drift recomputed from the merged counts) when
-    ``quality=True`` was passed."""
+    ``quality=True`` was passed; `versions` is the fleet-merged
+    `/versions` export (per-version splits summed, `current_by_worker`
+    naming which worker serves which ModelVersion — the rollout-skew
+    record) when ``versions=True`` was passed."""
     merged: dict
     workers: list   # [(ServiceInfo, raw state dict), ...]
     slo: Optional[dict] = None
     quality: Optional[dict] = None
+    versions: Optional[dict] = None
 
 
 def scrape_cluster(registry_address: str, name: Optional[str] = None,
@@ -529,7 +544,8 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
                    window: Optional[float] = None,
                    slo: bool = False,
                    kind: Optional[str] = None,
-                   quality: bool = False) -> ClusterSnapshot:
+                   quality: bool = False,
+                   versions: bool = False) -> ClusterSnapshot:
     """Pull `/metrics.json` from every worker the `ServiceRegistry` at
     `registry_address` knows (optionally one service `name`) and merge.
     A worker that died between registering and the scrape is skipped (its
@@ -544,11 +560,19 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
     `quality=True` also pulls each worker's `/quality` export and merges
     them with `telemetry.quality.merge_quality_exports` — live sketch
     counts sum exactly, fleet drift recomputes from the merged counts
-    (never averaged from per-worker scores). `kind` scrapes only
-    services of that registry kind (``"serving"`` / ``"trainer"``) — no
-    probing; the default merges both, which is well-defined because
-    trainer gauges (goodput) keep max and step histograms bucket-sum
-    exactly like every other metric."""
+    (never averaged from per-worker scores). `versions=True` also pulls
+    each worker's `/versions` export and merges it with
+    `telemetry.lineage.merge_version_exports` — per-version metric
+    splits sum exactly, and the result's `current_by_worker` map records
+    which worker serves which ModelVersion (the rollout-skew signal the
+    poller tracks); when combined with `slo=True`, per-worker verdicts
+    also group into `versions["slo_by_version"]` by each worker's
+    registered ServiceInfo.version, so a fleet SLO merge can be split by
+    model version. `kind` scrapes only services of that registry kind
+    (``"serving"`` / ``"trainer"``) — no probing; the default merges
+    both, which is well-defined because trainer gauges (goodput) keep
+    max and step histograms bucket-sum exactly like every other
+    metric."""
     from ..io.registry import ServiceInfo, list_services
     if name is not None:
         infos = list_services(registry_address, name, timeout=timeout)
@@ -565,6 +589,7 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
     workers = []
     slo_verdicts = []
     quality_exports = []
+    version_exports = []
     for info in infos:
         try:
             with urllib.request.urlopen(info.address + metrics_path,
@@ -573,7 +598,7 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
             if slo:
                 with urllib.request.urlopen(info.address + "/slo",
                                             timeout=timeout) as resp:
-                    slo_verdicts.append(json.loads(resp.read()))
+                    slo_verdicts.append((info, json.loads(resp.read())))
             if quality:
                 # isolated: a worker without /quality (a pre-quality
                 # version mid-rollout) keeps its metrics and SLO in the
@@ -582,6 +607,18 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
                     with urllib.request.urlopen(info.address + "/quality",
                                                 timeout=timeout) as resp:
                         quality_exports.append(json.loads(resp.read()))
+                except (OSError, ValueError):
+                    pass
+            if versions:
+                # same isolation as /quality: a pre-versions worker
+                # still merges its metrics/SLO
+                try:
+                    with urllib.request.urlopen(info.address + "/versions",
+                                                timeout=timeout) as resp:
+                        # keyed by address: unique per worker even when
+                        # every partition registers the same service name
+                        version_exports.append(
+                            (info.address, json.loads(resp.read())))
                 except (OSError, ValueError):
                     pass
             workers.append((info, state))
@@ -597,7 +634,7 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
     merged_slo = None
     if slo:
         from .slo import merge_verdicts
-        merged_slo = merge_verdicts(slo_verdicts)
+        merged_slo = merge_verdicts([v for _, v in slo_verdicts])
     merged_quality = None
     if quality:
         from .quality import merge_quality_exports
@@ -605,5 +642,27 @@ def scrape_cluster(registry_address: str, name: Optional[str] = None,
             merged_quality = merge_quality_exports(quality_exports)
         except Exception:  # noqa: BLE001 - the metrics/SLO merge stands
             merged_quality = None
+    merged_versions = None
+    if versions:
+        from .lineage import merge_version_exports
+        try:
+            merged_versions = merge_version_exports(version_exports)
+        except Exception:  # noqa: BLE001 - the metrics/SLO merge stands
+            merged_versions = None
+        if merged_versions is not None and slo:
+            # fleet SLO split by version: group per-worker verdicts by
+            # each worker's REGISTERED version (ServiceInfo.version) and
+            # merge each group exactly — a canary worker's burn no
+            # longer hides inside the fleet-wide verdict
+            from .slo import merge_verdicts as _mv
+            groups: dict = {}
+            for info, verdict in slo_verdicts:
+                ver = getattr(info, "version", None)
+                if ver is not None:
+                    groups.setdefault(ver, []).append(verdict)
+            if groups:
+                merged_versions["slo_by_version"] = {
+                    ver: _mv(vs) for ver, vs in groups.items()}
     return ClusterSnapshot(merged=merged, workers=workers, slo=merged_slo,
-                           quality=merged_quality)
+                           quality=merged_quality,
+                           versions=merged_versions)

@@ -78,6 +78,8 @@ QUALITY_LABELS_LATE = "quality.labels.late"
 QUALITY_LABELS_DUP = "quality.labels.dup"
 QUALITY_LABELS_DROPPED = "quality.labels.dropped"
 QUALITY_SKETCH_ROWS = "quality.sketch.rows"
+SERVING_MODEL_SWAPS = "serving.model.swaps"
+SERVING_MODEL_SWAP_ERRORS = "serving.model.swap_errors"
 
 COUNTERS = {
     SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
@@ -162,6 +164,10 @@ COUNTERS = {
                             "eviction, or injected label loss",
     QUALITY_SKETCH_ROWS: "served rows folded into the live quality "
                          "sketches (head-sampled by request id)",
+    SERVING_MODEL_SWAPS: "install_model hot-swaps committed (the old "
+                         "version's plans drain, never invalidate)",
+    SERVING_MODEL_SWAP_ERRORS: "install_model swaps that failed and "
+                               "rolled back to the incumbent handle",
     "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
                              "(process/thread)",
     "gbdt.hist.route.{route}": "histogram kernel-route selections "
@@ -190,6 +196,10 @@ TRAIN_LOST_SECONDS = "train.lost_seconds"
 TRAIN_STRAGGLERS = "train.stragglers"
 TELEMETRY_WATCH_TRIPPED = "telemetry.watch.tripped"
 QUALITY_DRIFT_MAX = "quality.drift.max"
+SERVING_MODEL_VERSION_INFO = "serving.model.version_info"
+CANARY_P99_RATIO = "canary.p99.ratio"
+CANARY_ERROR_BURN = "canary.error_burn"
+CANARY_DRIFT_DELTA = "canary.drift.delta"
 
 GAUGES = {
     ANALYSIS_SEMANTIC_CONTRACTS: "hot-path contracts analyzed by the last "
@@ -223,6 +233,15 @@ GAUGES = {
     QUALITY_DRIFT_MAX: "worst per-column PSI between the frozen "
                        "reference profile and the live serving sketches "
                        "(the quality SLO's drift-ceiling input)",
+    SERVING_MODEL_VERSION_INFO: "number of model versions currently "
+                                "tracked (incumbent + candidate); the "
+                                "served version ids ride /versions",
+    CANARY_P99_RATIO: "candidate windowed request p99 / incumbent frozen "
+                      "p99 (absent until a swap installs a candidate)",
+    CANARY_ERROR_BURN: "candidate windowed error rate / the canary error "
+                       "budget (absent until a swap installs a candidate)",
+    CANARY_DRIFT_DELTA: "candidate live quality.drift.max minus the "
+                        "incumbent's frozen drift at swap time",
     "quality.drift.{col}": "per-column PSI drift, reference vs live "
                            "sketch counts over the shared bucket grid "
                            "(refreshed on every exposition scrape)",
@@ -333,6 +352,7 @@ TRAIN_STRAGGLER_EVENT = "train.straggler"
 TELEMETRY_BUNDLE_EVENT = "telemetry.bundle"
 TELEMETRY_PROFILE_EVENT = "telemetry.profile"
 TELEMETRY_WATCH_TRIP_EVENT = "telemetry.watch.trip"
+SERVING_MODEL_SWAP_EVENT = "serving.model.swap"
 
 EVENTS = {
     FAULT_INJECTED_EVENT: "one FaultInjector firing (site, index, kind)",
@@ -350,6 +370,9 @@ EVENTS = {
     TRAIN_RESTART_EVENT: "supervisor restarted the step loop from the "
                          "in-memory snapshot",
     TRAIN_PREEMPTED_EVENT: "supervisor took the preemption exit",
+    SERVING_MODEL_SWAP_EVENT: "one committed install_model hot-swap "
+                              "(old/new version ids, plan-cache size "
+                              "attrs)",
     "registry.{action}": "registry HTTP hops (register/unregister) under "
                          "the caller's propagated trace",
 }
@@ -375,6 +398,10 @@ FAULT_SITES = {
     "quality.label": "StreamingEvaluator.record_label, fired per "
                      "arriving label (kind `drop` loses the label "
                      "before the join — counted quality.labels.dropped)",
+    "serving.swap": "ServingTransform.install_model, fired after the new "
+                    "handle is built but before it commits (a raise "
+                    "rolls back to the incumbent — counted "
+                    "serving.model.swap_errors)",
 }
 
 

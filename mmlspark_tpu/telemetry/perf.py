@@ -750,6 +750,13 @@ class FlightRecorder:
             # ({"active": false} on processes without a reference)
             from .quality import export_quality
             _json("quality.json", export_quality())
+            # deployment state (telemetry/lineage.py): which model
+            # versions this process serves, their roles, per-version
+            # metric splits, and the canary readout — a bundle tripped
+            # by a canary watch rule NAMES the candidate it indicts
+            # ({"versions": [], ...} on processes that never served)
+            from .lineage import export_versions
+            _json("versions.json", export_versions())
             manifest = {"reason": str(reason), "tag": tag, "seq": seq,
                         "pid": os.getpid(), "t": wall_now(), "path": path,
                         "files": files, "tracer": tracer.stats(),

@@ -64,7 +64,8 @@ class TelemetryPoller:
                  kind: Optional[str] = None,
                  jsonl_path: Optional[str] = None,
                  jsonl_max_bytes: int = 16 * 1024 * 1024,
-                 clock=None, quality: bool = False):
+                 clock=None, quality: bool = False,
+                 versions: bool = False):
         if interval_s <= 0.0:
             raise ValueError("interval_s must be > 0")
         self.registry_address = registry_address
@@ -92,6 +93,12 @@ class TelemetryPoller:
         # drift recomputed — telemetry/quality.py); the flat
         # quality.drift.* gauges ride the merged metrics either way
         self.quality = bool(quality)
+        # versions=True also pulls each worker's /versions export and
+        # keeps the fleet-merged result on the sample, plus the rollout
+        # skew (how many workers currently serve each model version) —
+        # a rollout that stalls half-deployed shows up as a persistent
+        # two-entry skew, not as any single worker's metric
+        self.versions = bool(versions)
         # fleet-side flight trigger: when the MERGED verdict transitions
         # to burning, dump a local debug bundle (telemetry/perf.py) — the
         # poller is the one process that sees the fleet burn even when no
@@ -137,7 +144,8 @@ class TelemetryPoller:
         snap = scrape_cluster(self.registry_address, name=self.name,
                               timeout=self.timeout, window=self.window_s,
                               slo=self.slo, kind=self.kind,
-                              quality=self.quality)
+                              quality=self.quality,
+                              versions=self.versions)
         sample = {"t": self._clock(),
                   "workers": snap.merged.get("telemetry.scrape.workers", 0),
                   "window_s": snap.merged.get("telemetry.scrape.window_s"),
@@ -145,6 +153,12 @@ class TelemetryPoller:
                   "slo": snap.slo}
         if self.quality:
             sample["quality"] = snap.quality
+        if self.versions:
+            sample["versions"] = snap.versions
+            if snap.versions:
+                from .lineage import rollout_skew
+                sample["rollout_skew"] = rollout_skew(
+                    snap.versions.get("current_by_worker", {}))
         with self._lock:
             self._samples.append(sample)
         reliability_metrics.inc(tnames.TELEMETRY_POLL_SAMPLES)

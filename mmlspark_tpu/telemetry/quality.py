@@ -708,7 +708,13 @@ class QualityMonitor:
                       ) -> "QualityMonitor":
         """Install the frozen reference profile (a `DatasetProfile` or
         its `state()` dict — the form the GBDT estimators stash on fitted
-        models) and spawn the live twin over the same grids."""
+        models) and spawn the live twin over the same grids.
+
+        `ServingTransform.install_model` calls this on every hot-swap
+        AFTER the version registry freezes the incumbent's canary
+        baseline (telemetry/lineage.py) — the baseline must read the OLD
+        reference's drift, and the reset below is what clears the old
+        model's stale `quality.drift.*` gauges from the swap onward."""
         prof = (profile if isinstance(profile, DatasetProfile)
                 else DatasetProfile.from_state(profile))
         with self._lock:

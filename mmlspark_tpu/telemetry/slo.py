@@ -121,6 +121,32 @@ def quality_objectives(drift_ceiling: float = 0.25,
     return out
 
 
+def canary_objectives(p99_ratio_max: float = 2.0,
+                      error_burn_max: float = 1.0,
+                      drift_delta_max: float = 0.25,
+                      window_s: float = 60.0) -> list:
+    """The canary objectives (telemetry/lineage.py): candidate-vs-
+    incumbent ceilings over the scrape-refreshed canary gauges — the
+    candidate's windowed p99 must stay under `p99_ratio_max` x the
+    incumbent's frozen p99, its server-fault rate under `error_burn_max`
+    x the canary error budget, and its live drift within
+    `drift_delta_max` PSI of the incumbent's frozen drift. All three
+    gauges are ABSENT until a hot-swap has produced an incumbent AND a
+    candidate, and a no-data window burns 0 — a fleet that never swapped
+    cannot trip its canary. This is the rollback *signal* (verdict ->
+    FlightRecorder, `versions.json` in the bundle); actuation stays with
+    the control plane (ROADMAP item 3)."""
+    return [Objective(name="canary.p99", kind=QUALITY,
+                      metric=tnames.CANARY_P99_RATIO,
+                      ceiling=p99_ratio_max, window_s=window_s),
+            Objective(name="canary.errors", kind=QUALITY,
+                      metric=tnames.CANARY_ERROR_BURN,
+                      ceiling=error_burn_max, window_s=window_s),
+            Objective(name="canary.drift", kind=QUALITY,
+                      metric=tnames.CANARY_DRIFT_DELTA,
+                      ceiling=drift_delta_max, window_s=window_s)]
+
+
 def _violations_over(counts: list, threshold_ms: float) -> int:
     """Observations in buckets strictly above the threshold's bucket —
     the merge-safe over-threshold count (threshold snaps DOWN to its

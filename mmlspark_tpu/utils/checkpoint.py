@@ -36,6 +36,20 @@ def _file_sha256(path: str) -> str:
     return h.hexdigest()
 
 
+def array_sha256(arr) -> str:
+    """SHA-256 of one array's CONTENT, dtype/shape-qualified: the header
+    keeps a float32 zero-vector from colliding with the float64 one, and
+    `ascontiguousarray` makes the digest independent of the source's
+    stride layout. This is the fitted-weight digest `telemetry.lineage`
+    builds ModelVersion identity from (the checkpoint digests above hash
+    the serialized FILES; this hashes the live in-memory arrays)."""
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(f"{a.dtype}{a.shape}:".encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def _canonical_meta(meta: dict) -> bytes:
     """Canonical bytes of the meta payload (sans the _digests record) for
     content digesting: sort_keys + fixed separators make the dump identical

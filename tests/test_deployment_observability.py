@@ -1,0 +1,731 @@
+"""Deployment observability (ISSUE 15): content-addressed model
+versions, zero-downtime hot-swap with version-dimensioned telemetry,
+and fleet canary verdicts.
+
+Pins the new contracts: the two-digest identity (structural fingerprint
+vs fitted-array content digest — two fits of one architecture are
+DIFFERENT versions, and different plan-cache keys); the append-only
+RunLedger journals every fit with its lineage record; `install_model`
+commits atomically with zero dropped requests under live load while the
+incumbent's plans drain (never invalidated) and every reply carries
+`X-Model-Version`; a failed swap — including the seeded `serving.swap`
+chaos site — rolls back to the incumbent; `GET /versions` answers on
+every exposition surface and `scrape_cluster(versions=True)` merges the
+fleet exactly (splits sum, rollout skew tracked); the canary gauges stay
+absent until a swap produces incumbent + candidate, then a bad candidate
+flips `canary_objectives()` to burning, trips the watch rules, and the
+flight bundle's versions.json names the candidate it indicts."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.core import Table
+from mmlspark_tpu.reliability.faults import FaultInjector
+from mmlspark_tpu.reliability.metrics import reliability_metrics
+from mmlspark_tpu.telemetry import lineage as tlineage
+from mmlspark_tpu.telemetry import names as tnames
+from mmlspark_tpu.telemetry import perf
+from mmlspark_tpu.telemetry import quality as Q
+from mmlspark_tpu.telemetry import slo as tslo
+
+
+@pytest.fixture
+def deploy_state():
+    """Fresh metrics + quality monitor + version registry; restore after."""
+    reliability_metrics.reset()
+    Q.reset_monitor()
+    tlineage.reset_version_registry()
+    tlineage.configure_run_ledger(None)
+    yield
+    tlineage.configure_run_ledger(None)
+    tlineage.reset_version_registry()
+    Q.reset_monitor()
+    reliability_metrics.reset()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=15)
+    return resp, json.loads(resp.read())
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=15) as resp:
+        return json.loads(resp.read())
+
+
+def _fit(seed=0, n=400, f=5, iters=4, **kw):
+    """One fitted booster; different seeds -> different fitted arrays
+    (distinct content digests), same architecture."""
+    from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    model = GBDTClassifier(num_iterations=iters, max_depth=3, **kw).fit(
+        Table({"features": x, "label": y}))
+    return model, x, y
+
+
+# ----------------------------------------------- content-addressed identity
+def test_model_version_two_digest_contract(deploy_state):
+    """Satellite (a): content=True hashes fitted-array BYTES — two fits
+    of one architecture get different version ids; content=False falls
+    back to the structural digest."""
+    a, _, _ = _fit(seed=0)
+    b, _, _ = _fit(seed=1)
+    mva, mvb = tlineage.model_version(a), tlineage.model_version(b)
+    assert mva.content_digest and mvb.content_digest
+    assert mva.content_digest != mvb.content_digest
+    assert mva.version != mvb.version
+    assert mva.version == mva.content_digest[:12]
+    # deterministic: re-digesting the same model reproduces the identity
+    assert tlineage.model_version(a).version == mva.version
+    # structural-only mode: version prefixes the structural fingerprint
+    sa = tlineage.model_version(a, content=False)
+    assert sa.content_digest is None
+    assert sa.version == sa.fingerprint[:12]
+    # export is JSON-safe and carries the lineage record
+    exported = mva.export()
+    json.dumps(exported)
+    assert exported["version"] == mva.version
+    assert exported["lineage"]["estimator"] == "GBDTClassifier"
+
+
+def test_array_sha256_content_addresses_values_and_dtype():
+    from mmlspark_tpu.utils.checkpoint import array_sha256
+    x = np.arange(6, dtype=np.float32)
+    assert array_sha256(x) == array_sha256(x.copy())
+    y = x.copy()
+    y[0] += 1
+    assert array_sha256(x) != array_sha256(y)
+    assert array_sha256(x) != array_sha256(x.astype(np.float64))
+    assert array_sha256(x) != array_sha256(x.reshape(2, 3))
+
+
+def test_run_ledger_append_records_and_torn_line(deploy_state, tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    ledger = tlineage.RunLedger(str(path))
+    assert ledger.records() == []        # missing file reads empty
+    ledger.append({"version": "aaa", "step": 1})
+    ledger.append({"version": "bbb", "step": 2})
+    # a torn tail line (crashed writer) is skipped, not fatal
+    with open(path, "ab") as f:
+        f.write(b'{"version": "ccc", "st')
+    recs = ledger.records()
+    assert [r["version"] for r in recs] == ["aaa", "bbb"]
+    # configure/get round-trip; None clears
+    assert tlineage.configure_run_ledger(str(path)) is not None
+    assert tlineage.get_run_ledger().path == str(path)
+    tlineage.configure_run_ledger(None)
+    assert tlineage.get_run_ledger() is None
+
+
+def test_gbdt_fit_stamps_lineage_and_journals_ledger(deploy_state,
+                                                     tmp_path):
+    """The estimators stamp `model.lineage` (params snapshot, reference-
+    profile digest, resumable checkpoint step) and journal the fit to
+    the configured RunLedger."""
+    tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+    model, _, _ = _fit(seed=0, checkpoint_dir=str(tmp_path / "ckpt"),
+                       checkpoint_interval=2)
+    rec = model.lineage
+    assert rec["estimator"] == "GBDTClassifier"
+    assert rec["uid"]
+    assert rec["params"]["num_iterations"] == 4
+    assert len(rec["reference_profile"]) == 12
+    assert rec["checkpoint_step"] is not None
+    json.dumps(rec)                      # JSON-safe end to end
+    entries = tlineage.get_run_ledger().records()
+    assert len(entries) == 1
+    assert entries[0]["version"] == tlineage.model_version(model).version
+    assert entries[0]["lineage"]["estimator"] == "GBDTClassifier"
+
+
+# ------------------------------------------------------- version registry
+def test_version_registry_install_observe_export_bounded(deploy_state):
+    reg = tlineage.get_version_registry()
+    a, _, _ = _fit(seed=0)
+    b, _, _ = _fit(seed=1)
+    c, _, _ = _fit(seed=2)
+    mva = tlineage.model_version(a)
+    mvb = tlineage.model_version(b)
+    swap = reg.install(mva)
+    assert swap == {"old": None, "new": mva.version}
+    # same-version reinstall is a no-op (no baseline freeze)
+    assert reg.install(mva)["old"] == mva.version
+    assert reg.export()["versions"][mva.version]["role"] == "candidate"
+    reg.observe(mva.version, ms=2.0, rows=4)
+    reg.observe(mva.version, ms=4.0, rows=4, errors=1)
+    swap = reg.install(mvb)
+    assert swap == {"old": mva.version, "new": mvb.version}
+    assert reg.current_version() == mvb.version
+    exp = reg.export()
+    inc = exp["versions"][mva.version]
+    assert inc["role"] == "incumbent"
+    assert inc["frozen"]["requests"] == 8
+    assert inc["frozen"]["errors"] == 1
+    assert inc["frozen"]["error_rate"] == pytest.approx(1 / 8)
+    assert inc["frozen"]["p99_ms"] is not None
+    cand = exp["versions"][mvb.version]
+    assert cand["role"] == "candidate" and cand["frozen"] is None
+    assert exp["current"] == mvb.version
+    assert reliability_metrics.peek_gauge(
+        tnames.SERVING_MODEL_VERSION_INFO) == 2.0
+    # unknown-version observations drop silently (drained plan tail)
+    reg.observe("deadbeef0000", ms=1.0)
+    # bounded: a third install evicts the oldest slot
+    reg.install(tlineage.model_version(c))
+    assert mva.version not in reg.export()["versions"]
+    assert len(reg.export()["versions"]) == tlineage.MAX_VERSION_SLOTS
+
+
+def test_canary_gauges_absent_until_both_then_objectives_burn(
+        deploy_state):
+    """The gauges stay ABSENT until a swap produces incumbent AND
+    candidate (SLO reads no_data, burn 0 — a fleet that never swapped
+    can't trip its canary); then a slow/erroring candidate burns."""
+    reg = tlineage.get_version_registry()
+    engine = tslo.SLOEngine(objectives=tslo.canary_objectives(),
+                            registry=reliability_metrics)
+    a, _, _ = _fit(seed=0)
+    b, _, _ = _fit(seed=1)
+    assert tlineage.refresh_canary_gauges() == {}
+    verdict = engine.verdict(notify=False)
+    assert verdict["ok"] and not verdict["burning"]
+    mva, mvb = tlineage.model_version(a), tlineage.model_version(b)
+    reg.install(mva)
+    for _ in range(50):
+        reg.observe(mva.version, ms=1.0)
+    assert tlineage.refresh_canary_gauges() == {}   # still single-version
+    reg.install(mvb)
+    for _ in range(50):
+        reg.observe(mvb.version, ms=10.0, errors=1)  # slow AND erroring
+    vals = tlineage.refresh_canary_gauges()
+    assert vals["candidate"] == mvb.version
+    assert vals["incumbent"] == mva.version
+    assert vals["p99_ratio"] > 2.0
+    assert vals["error_burn"] > 1.0
+    assert reliability_metrics.peek_gauge(tnames.CANARY_P99_RATIO) \
+        == pytest.approx(vals["p99_ratio"])
+    verdict = engine.verdict(notify=False)
+    burning = {o["objective"]["name"]: o["burning"]
+               for o in verdict["objectives"]}
+    assert burning["canary.p99"] is True
+    assert burning["canary.errors"] is True
+    assert verdict["burning"] is True
+
+    # the watch rules trip on the same gauges' series (transition)
+    from mmlspark_tpu.telemetry.watch import TelemetryWatcher
+    watcher = TelemetryWatcher(rules=tlineage.canary_watch_rules(),
+                               recorder=None)
+    trips = watcher.check(series={
+        tnames.CANARY_P99_RATIO: [(1.0, 1.0), (2.0, vals["p99_ratio"])],
+        tnames.CANARY_ERROR_BURN: [(1.0, 0.0), (2.0, vals["error_burn"])]})
+    assert {t["key"] for t in trips} == {tnames.CANARY_P99_RATIO,
+                                         tnames.CANARY_ERROR_BURN}
+
+
+# ------------------------------------------------------------- hot-swap
+def test_hot_swap_under_load_drops_zero_requests(deploy_state):
+    """Satellite (c): install_model mid-load — every request answers
+    200, the swap commits exactly once, and both versions' splits land
+    in the registry."""
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    model_a, _, _ = _fit(seed=0, n=800, f=5)
+    model_b, _, _ = _fit(seed=1, n=800, f=5)
+    server, q = serve_pipeline(model_a, input_cols=["features"],
+                               mode="microbatch", max_batch=64)
+    host, port = server._httpd.server_address[:2]
+    body = json.dumps({"features": [0.5] * 5})
+    try:
+        transform = q.transform_fn
+        results = []
+        t = threading.Thread(target=lambda: results.append(
+            run_load(host, port, body, n_clients=8, per_client=40)))
+        t.start()
+        # swap once traffic is demonstrably in flight
+        deadline = time.monotonic() + 10.0
+        while (reliability_metrics.get(tnames.SERVING_REQUEST_TOTAL) < 20
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        swap = transform.install_model(model_b)
+        t.join()
+        res = results[0]
+        assert not res.errors, res.errors[:3]
+        assert res.n_ok == 8 * 40
+        assert transform.version == swap["new"]
+        assert reliability_metrics.get(tnames.SERVING_MODEL_SWAPS) == 1
+        assert reliability_metrics.get(
+            tnames.SERVING_MODEL_SWAP_ERRORS) == 0
+        exp = _get_json(server.address + "/versions")
+        assert exp["current"] == swap["new"]
+        assert set(exp["versions"]) == {swap["old"], swap["new"]}
+        splits = {v: e["frozen"] if e["frozen"] is not None else e["split"]
+                  for v, e in exp["versions"].items()}
+        assert sum(s["requests"] for s in splits.values()) == 8 * 40
+        assert splits[swap["old"]]["requests"] > 0
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_hot_swap_old_plans_drain_new_version_stamps(deploy_state):
+    """The incumbent's plans DRAIN out of the bounded LRU under the new
+    version's traffic — never invalidated (a held old plan still
+    scores) — and `plan.recompiles` stays 0 across the swap because the
+    content-qualified fingerprint gives the retrain fresh keys."""
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    model_a, _, _ = _fit(seed=0)
+    model_b, _, _ = _fit(seed=1)
+    transform = compile_serving_transform(model_a, ["features"],
+                                          max_plans=2)
+    body = [json.dumps({"features": [0.1] * 5}).encode()]
+    out = transform(body * 3)
+    old_plan = transform._plan_for(3)       # hold the incumbent's plan
+    old_version = transform.version
+    assert out[0].version == old_version
+    swap = transform.install_model(model_b)
+    assert swap["old"] == old_version and swap["new"] != old_version
+    assert transform.stats()["stale_plans"] == 1
+    # new traffic across two buckets: the candidate's keys fill the
+    # 2-slot LRU, evicting (draining) the incumbent's entry
+    out = transform(body * 3)
+    assert out[0].version == swap["new"]
+    transform(body * 5)
+    stats = transform.stats()
+    assert stats["stale_plans"] == 0, stats
+    assert stats["evictions"] >= 1
+    # the drained plan was never closed: it still scores
+    assemble, run = old_plan
+    preds = run(assemble([{"features": [0.1] * 5}] * 3))
+    assert len(preds) == 3
+    # no key was ever built twice — swap compiles are misses, not
+    # recompiles
+    assert reliability_metrics.get(tnames.PLAN_RECOMPILES) == 0
+
+
+def test_hot_swap_clears_stale_drift_gauges_and_swaps_reference(
+        deploy_state):
+    """Satellite (c): the swap installs the candidate's quality
+    reference, clearing the incumbent's stale quality.drift.* gauges —
+    the new version never reports the old one's drift."""
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    model_a, _, _ = _fit(seed=0)
+    model_b, _, _ = _fit(seed=1)
+    transform = compile_serving_transform(model_a, ["features"])
+    mon = Q.get_monitor()
+    assert mon.active
+    mon.configure(sample=1.0, min_live=8)
+    rng = np.random.default_rng(3)
+    shifted = (rng.normal(size=(32, 5)) + 5.0).astype(np.float32)
+    transform([json.dumps({"features": [float(v) for v in row]}).encode()
+               for row in shifted])
+    Q.refresh_quality_gauges()
+    assert reliability_metrics.peek_gauge(
+        tnames.QUALITY_DRIFT_MAX) is not None
+    transform.install_model(model_b)
+    assert reliability_metrics.peek_gauge(
+        tnames.QUALITY_DRIFT_MAX) is None   # stale gauges cleared
+    assert Q.get_monitor().active           # candidate's reference live
+
+
+def test_chaos_failed_swap_rolls_back_to_incumbent(deploy_state):
+    """Satellite (f): a fault at the seeded `serving.swap` site aborts
+    the install BEFORE the commit point — the incumbent keeps serving
+    every request, `serving.model.swap_errors` counts it, and a retry
+    succeeds."""
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.reliability.faults import InjectedFault
+    RULES = [{"site": "serving.swap", "kind": "error", "at": [0]}]
+    inj = FaultInjector(seed=1337, rules=RULES)
+    model_a, x, _ = _fit(seed=0)
+    model_b, _, _ = _fit(seed=1)
+    server, q = serve_pipeline(model_a, input_cols=["features"],
+                               mode="microbatch", faults=inj)
+    try:
+        transform = q.transform_fn
+        incumbent = transform.version
+        with pytest.raises(InjectedFault):
+            transform.install_model(model_b)
+        assert transform.version == incumbent           # rolled back
+        assert reliability_metrics.get(
+            tnames.SERVING_MODEL_SWAP_ERRORS) == 1
+        assert reliability_metrics.get(tnames.SERVING_MODEL_SWAPS) == 0
+        resp, reply = _post(server.address,
+                            {"features": [float(v) for v in x[0]]})
+        assert resp.status == 200 and "prediction" in reply
+        assert resp.headers["X-Model-Version"] == incumbent
+        # the registry never tracked the aborted candidate
+        exp = _get_json(server.address + "/versions")
+        assert list(exp["versions"]) == [incumbent]
+        # the schedule fired once: the retry commits
+        swap = transform.install_model(model_b)
+        assert transform.version == swap["new"] != incumbent
+        assert reliability_metrics.get(tnames.SERVING_MODEL_SWAPS) == 1
+    finally:
+        q.stop()
+        server.stop()
+
+
+# ------------------------------------------------- wire compat + surfaces
+def test_register_wire_format_default_omits_version(deploy_state):
+    """Satellite (b): an unversioned register posts the pre-version body
+    byte-for-byte (same contract as `kind`), the registry accepts a
+    version-less body, and a versioned register round-trips."""
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    from mmlspark_tpu.io.registry import ServiceInfo
+    info = ServiceInfo(name="w", host="h", port=9, process_id=0,
+                       num_partitions=1)
+    body = info._asdict()
+    body.pop("kind")
+    body.pop("version")
+    assert list(body) == ["name", "host", "port", "process_id",
+                          "num_partitions"]       # the pre-version body
+    reg = ServiceRegistry().start()
+    try:
+        req = urllib.request.Request(
+            reg.address + "/register", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        assert urllib.request.urlopen(req, timeout=15).status == 200
+        assert reg.services("w")[0].version is None
+        report_server_to_registry(reg.address, "v", "127.0.0.1", 10,
+                                  version="abc123def456")
+        assert reg.services("v")[0].version == "abc123def456"
+    finally:
+        reg.stop()
+
+
+def test_versions_endpoint_on_every_surface(deploy_state):
+    """GET /versions rides EXPOSITION_PATHS everywhere: both serving
+    transports, the ServiceRegistry, and the trainer ExpositionServer."""
+    from mmlspark_tpu.io.registry import ServiceRegistry
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+    from mmlspark_tpu.telemetry.exposition import ExpositionServer
+    model, _, _ = _fit(seed=0)
+    mv = tlineage.model_version(model)
+    tlineage.get_version_registry().install(mv)
+    servers, queries = [], []
+    for transport in ("selector", "threading"):
+        s = ServingServer(num_partitions=1, transport=transport).start()
+        queries.append(ServingQuery(
+            s, lambda bodies: [{"ok": 1}] * len(bodies),
+            mode="continuous").start())
+        servers.append(s)
+    reg = ServiceRegistry().start()
+    expo = ExpositionServer().start()
+    try:
+        for addr in [s.address for s in servers] + [reg.address,
+                                                    expo.address]:
+            payload = _get_json(addr + "/versions")
+            assert payload["current"] == mv.version
+            assert mv.version in payload["versions"]
+    finally:
+        for q in queries:
+            q.stop()
+        for s in servers:
+            s.stop()
+        reg.stop()
+        expo.stop()
+
+
+def test_x_model_version_header_on_both_transports(deploy_state):
+    """Every reply is stamped with the version that scored it, on the
+    selector AND threading ingress."""
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+    model, x, _ = _fit(seed=0)
+    for transport in ("selector", "threading"):
+        transform = compile_serving_transform(model, ["features"])
+        server = ServingServer(num_partitions=1,
+                               transport=transport).start()
+        q = ServingQuery(server, transform, mode="continuous").start()
+        try:
+            resp, reply = _post(server.address,
+                                {"features": [float(v) for v in x[0]]})
+            assert "prediction" in reply
+            assert resp.headers["X-Model-Version"] == transform.version
+            # per-row 400s carry the stamp too (the version ANSWERED it)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(server.address, {"wrong": 1})
+            assert err.value.code == 400
+            assert err.value.headers["X-Model-Version"] \
+                == transform.version
+        finally:
+            q.stop()
+            server.stop()
+
+
+def test_scrape_cluster_versions_merges_fleet_and_tracks_skew(
+        deploy_state):
+    """Satellite (b): `scrape_cluster(versions=True)` merges per-worker
+    /versions exports exactly — splits sum, workers listed per version,
+    rollout skew from current_by_worker — and slo_by_version groups the
+    fleet verdicts; the poller keeps both on its sample."""
+    from mmlspark_tpu.io import ServiceRegistry, report_server_to_registry
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.telemetry.exposition import scrape_cluster
+    from mmlspark_tpu.telemetry.poller import TelemetryPoller
+    model, x, _ = _fit(seed=0)
+    reg = ServiceRegistry().start()
+    s1, q1 = serve_pipeline(model, input_cols=["features"],
+                            mode="continuous")
+    s2, q2 = serve_pipeline(model, input_cols=["features"],
+                            mode="continuous")
+    try:
+        ver = q1.transform_fn.version
+        for name, s in (("va", s1), ("vb", s2)):
+            host, port = s._httpd.server_address[:2]
+            report_server_to_registry(reg.address, name, host, port,
+                                      version=ver)
+        for i in range(6):
+            _post(s1.address, {"features": [float(v) for v in x[i]]})
+        single = _get_json(s1.address + "/versions")
+        snap = scrape_cluster(reg.address, versions=True, slo=True)
+        assert snap.versions is not None
+        merged = snap.versions["versions"][ver]
+        # workers keyed by ADDRESS: unique even when every partition
+        # registers the same service name
+        addrs = sorted((s1.address, s2.address))
+        assert merged["workers"] == addrs
+        assert snap.versions["current_by_worker"] == {
+            a: ver for a in addrs}
+        assert tlineage.rollout_skew(
+            snap.versions["current_by_worker"]) == {ver: 2}
+        # both workers share one process registry here, so the merged
+        # split is exactly 2x one worker's export — counts SUM
+        one = single["versions"][ver]["metrics"]["counters"][
+            tnames.SERVING_REQUEST_TOTAL]
+        assert one >= 6
+        assert merged["metrics"]["counters"][
+            tnames.SERVING_REQUEST_TOTAL] == 2 * one
+        assert ver in snap.versions["slo_by_version"]
+        assert snap.versions["slo_by_version"][ver]["workers"] == 2
+        # the poller carries the merged export + skew on each sample
+        poller = TelemetryPoller(reg.address, interval_s=60.0,
+                                 versions=True)
+        sample = poller.poll_once()
+        assert sample["versions"]["current_by_worker"] == {
+            a: ver for a in addrs}
+        assert sample["rollout_skew"] == {ver: 2}
+    finally:
+        q1.stop()
+        q2.stop()
+        s1.stop()
+        s2.stop()
+        reg.stop()
+
+
+def test_flight_bundle_carries_versions_json(deploy_state, tmp_path):
+    """Every bundle embeds the /versions export — a bundle tripped by a
+    canary names the candidate it indicts."""
+    rec = perf.get_flight_recorder()
+    rec.configure(bundle_dir=str(tmp_path), min_interval_s=0.0)
+    try:
+        reg = tlineage.get_version_registry()
+        a, _, _ = _fit(seed=0)
+        b, _, _ = _fit(seed=1)
+        mva, mvb = tlineage.model_version(a), tlineage.model_version(b)
+        reg.install(mva)
+        reg.observe(mva.version, ms=1.0)
+        reg.install(mvb)
+        rec.dump(reason="test-canary")
+        bundles = sorted(tmp_path.glob("bundle-*"))
+        assert bundles
+        payload = json.loads(
+            (bundles[-1] / "versions.json").read_text())
+        assert payload["current"] == mvb.version
+        assert payload["canary"]["candidate"] == mvb.version
+        assert payload["canary"]["incumbent"] == mva.version
+    finally:
+        rec.configure(bundle_dir="")
+
+
+def test_benchdiff_carries_model_version_stamp():
+    """Satellite (e): the serving-bench trajectory and regression
+    verdicts carry the fitted model's version, so a perf delta is
+    attributable to a model swap vs a code change."""
+    from mmlspark_tpu.telemetry.benchdiff import diff_rounds
+    rounds = [
+        ("r01", {"serving": {"value": 100.0,
+                             "model_version": "aaa111aaa111"}}),
+        ("r02", {"serving": {"value": 50.0,
+                             "model_version": "bbb222bbb222"}}),
+    ]
+    lines, regressions = diff_rounds(rounds, threshold=0.1)
+    traj = next(ln for ln in lines if ln.startswith("serving"))
+    assert "r01:100@aaa111aaa111" in traj
+    assert "r02:50@bbb222bbb222" in traj
+    assert len(regressions) == 1
+    assert "model_version aaa111aaa111 -> bbb222bbb222" in regressions[0]
+    # unstamped rounds render exactly as before, and a same-version
+    # regression carries no swap annotation
+    lines, regressions = diff_rounds(
+        [("r01", {"b": {"value": 100.0, "model_version": "ccc"}}),
+         ("r02", {"b": {"value": 50.0, "model_version": "ccc"}})],
+        threshold=0.1)
+    assert "model_version" not in regressions[0]
+    lines, _ = diff_rounds([("r01", {"b": {"value": 1.0}}),
+                            ("r02", {"b": {"value": 1.0}})])
+    assert "@" not in lines[0]
+
+
+# ------------------------------------------------------- acceptance (e2e)
+def test_acceptance_hot_swap_canary_indicts_bad_candidate(
+        deploy_state, tmp_path):
+    """ISSUE 15 acceptance: two fitted versions through one worker —
+    a mid-load hot-swap drops ZERO requests, GET /versions carries both
+    versions' lineage and per-version splits, and a seeded bad candidate
+    (injected scoring delay + 5-sigma drifted traffic) flips the canary
+    objectives to burning, trips the canary watch rules, and the flight
+    bundle's versions.json names the candidate — while the incumbent's
+    error objective stays ok. Deterministic: fixed fit seeds, seeded
+    traffic, no wall-clock dependence."""
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.telemetry.watch import TelemetryWatcher
+    tracer = telemetry.get_tracer()
+    tracer.configure(sample=1.0)
+    tracer.clear()
+    rec = perf.get_flight_recorder()
+    rec.configure(bundle_dir=str(tmp_path), min_interval_s=0.0)
+    model_a, x, _ = _fit(seed=0, n=800)
+    model_b, _, _ = _fit(seed=1, n=800)
+    # the seeded badness: the candidate's scoring kernel sleeps — its
+    # windowed p99 blows past the incumbent's frozen baseline
+    real_kernel_of = model_b._serving_kernel
+
+    def slow_kernel_of(output_col):
+        kernel = real_kernel_of(output_col)
+
+        def slow(batch):
+            time.sleep(0.01)
+            return kernel(batch)
+        slow.expected_features = getattr(kernel, "expected_features",
+                                         None)
+        return slow
+    model_b._serving_kernel = slow_kernel_of
+
+    server, q = serve_pipeline(model_a, input_cols=["features"],
+                               mode="microbatch", max_batch=64)
+    host, port = server._httpd.server_address[:2]
+    engine = tslo.configure(tslo.canary_objectives())
+    assert engine is not None
+    try:
+        transform = q.transform_fn
+        mon = Q.get_monitor()
+        mon.configure(sample=1.0, min_live=16)
+
+        # phase 1 — the incumbent's healthy baseline: in-distribution
+        # traffic builds its latency split and (small) live drift
+        # (enough rows that small-sample PSI noise stays well under the
+        # frozen-baseline comparison)
+        for row in x[:200]:
+            _post(server.address,
+                  {"features": [float(v) for v in row]})
+        assert not _get_json(server.address + "/slo")["burning"]
+
+        # phase 2 — hot-swap under live load: zero dropped requests.
+        # The load generator repeats ONE body (a point mass, not a
+        # distribution) — keep it out of the drift sketches so both the
+        # frozen baseline and the candidate's drift read real traffic
+        mon.configure(sample=0.0)
+        results = []
+        body = json.dumps({"features": [0.5] * 5})
+        t = threading.Thread(target=lambda: results.append(
+            run_load(host, port, body, n_clients=8, per_client=30)))
+        before = reliability_metrics.get(tnames.SERVING_REQUEST_TOTAL)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while (reliability_metrics.get(tnames.SERVING_REQUEST_TOTAL)
+               < before + 20 and time.monotonic() < deadline):
+            time.sleep(0.002)
+        swap = transform.install_model(model_b)
+        t.join()
+        res = results[0]
+        assert not res.errors, res.errors[:3]
+        assert res.n_ok == 8 * 30                     # zero dropped
+        va, vb = swap["old"], swap["new"]
+        assert transform.version == vb != va
+
+        # phase 3 — the candidate serves 5-sigma drifted traffic through
+        # its slowed kernel (sketching back on; the swap's set_reference
+        # reset the live twin, so the candidate's drift is ONLY this)
+        mon.configure(sample=1.0)
+        rng = np.random.default_rng(15)
+        for row in rng.normal(size=(48, 5)) + 5.0:
+            _post(server.address,
+                  {"features": [float(v) for v in row]})
+
+        # GET /versions: both versions' lineage + per-version splits
+        exp = _get_json(server.address + "/versions")
+        assert exp["current"] == vb
+        assert set(exp["versions"]) == {va, vb}
+        assert exp["versions"][va]["role"] == "incumbent"
+        assert exp["versions"][vb]["role"] == "candidate"
+        for entry in exp["versions"].values():
+            assert entry["lineage"]["estimator"] == "GBDTClassifier"
+        assert exp["versions"][va]["frozen"]["requests"] > 0
+        assert exp["versions"][vb]["split"]["requests"] >= 48
+
+        # a /metrics scrape refreshes the canary gauges: the slow,
+        # drifted candidate reads burning on p99 AND drift
+        urllib.request.urlopen(server.address + "/metrics",
+                               timeout=15).read()
+        ratio = reliability_metrics.peek_gauge(tnames.CANARY_P99_RATIO)
+        delta = reliability_metrics.peek_gauge(tnames.CANARY_DRIFT_DELTA)
+        assert ratio is not None and ratio > 2.0
+        assert delta is not None and delta > 0.25
+
+        # the canary watch rules trip on the gauge series
+        watcher = TelemetryWatcher(rules=tlineage.canary_watch_rules(),
+                                   recorder=None)
+        trips = watcher.check(series={
+            tnames.CANARY_P99_RATIO: [(1.0, 1.0), (2.0, ratio)],
+            tnames.CANARY_DRIFT_DELTA: [(1.0, 0.0), (2.0, delta)]})
+        assert {t["key"] for t in trips} == {tnames.CANARY_P99_RATIO,
+                                             tnames.CANARY_DRIFT_DELTA}
+
+        # the SLO verdict burns on the canary objectives — but the
+        # error-budget objective (the incumbent-health axis) stays ok
+        verdict = _get_json(server.address + "/slo")
+        obj = {o["objective"]["name"]: o for o in verdict["objectives"]}
+        assert obj["canary.p99"]["burning"] is True
+        assert obj["canary.drift"]["burning"] is True
+        assert obj["canary.errors"]["burning"] is False
+        assert verdict["burning"] is True
+
+        # the burn transition dumps a flight bundle whose versions.json
+        # NAMES the candidate it indicts
+        bundles, deadline = [], time.monotonic() + 5.0
+        while not bundles and time.monotonic() < deadline:
+            bundles = sorted(tmp_path.glob("bundle-*"))
+            time.sleep(0.01)
+        assert bundles, "burning canary left no flight bundle"
+        dump = json.loads((bundles[-1] / "versions.json").read_text())
+        assert dump["canary"]["candidate"] == vb
+        assert dump["canary"]["incumbent"] == va
+        assert dump["current"] == vb
+
+        # causal order: the swap event precedes the bundle event
+        events = {s["name"]: s["seq"] for s in tracer.finished()
+                  if s.get("kind") == "event"}
+        assert tnames.SERVING_MODEL_SWAP_EVENT in events
+        assert tnames.TELEMETRY_BUNDLE_EVENT in events
+        assert events[tnames.SERVING_MODEL_SWAP_EVENT] \
+            < events[tnames.TELEMETRY_BUNDLE_EVENT]
+    finally:
+        tslo.configure(None)
+        rec.configure(bundle_dir="")
+        tracer.configure(sample=0.0)
+        tracer.clear()
+        q.stop()
+        server.stop()

@@ -85,11 +85,11 @@ def test_plan_cache_miss_stampede_single_flight():
     release = threading.Event()
     real_build = transform._build_plan
 
-    def slow_build(bucket):
+    def slow_build(bucket, handle=None):
         builds.append(bucket)
         in_build.set()
         assert release.wait(10), "test orchestration stalled"
-        return real_build(bucket)
+        return real_build(bucket, handle)
 
     transform._build_plan = slow_build
     plans = []
@@ -120,11 +120,11 @@ def test_plan_build_failure_not_cached():
     real_build = transform._build_plan
     calls = []
 
-    def failing_once(bucket):
+    def failing_once(bucket, handle=None):
         calls.append(bucket)
         if len(calls) == 1:
             raise RuntimeError("transient build failure")
-        return real_build(bucket)
+        return real_build(bucket, handle)
 
     transform._build_plan = failing_once
     with pytest.raises(RuntimeError, match="transient"):
