@@ -31,6 +31,7 @@ vs_baseline > 1.0 means we beat that on this chip.
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -965,6 +966,140 @@ def _bench_quality():
         "backend": jax.default_backend(), **out}))
 
 
+class _PoisonModel:
+    """A candidate whose artifact cannot score: `transform` raises on
+    every batch (server-side -> 502s, the SLO error-budget numerator).
+    The classic bad deploy the control loop must catch — it installs
+    fine, versions fine (structural digest over `_get_state`), and only
+    fails under traffic."""
+
+    def transform(self, table):
+        raise RuntimeError("bad candidate: artifact cannot score")
+
+    def _get_state(self):
+        return {"poison": np.asarray([1.0], np.float32)}
+
+
+def _bench_fleet():
+    """Closed-loop FLEET bench (ISSUE 16 tentpole acceptance): loadgen
+    against N in-process workers behind the weighted routing tier, with
+    the rollout control loop live.
+
+    Phase A measures steady-state fleet req/s through `WeightedRouter`
+    (registry-discovered targets, scrape-derived weights). Phase B
+    injects a POISON candidate mid-load via `RolloutDriver` — the
+    candidate's 502s burn the (short-windowed) error-budget objective,
+    the driver auto-rolls-back to the incumbent, and the fleet `/slo`
+    verdict returns to ok — while the load generator keeps every client
+    alive across the burn. The emitted record carries the acceptance
+    numbers: `requests_dropped` (MUST be 0 — every request sent got an
+    answer, even mid-rollback) and `rollback_window_p99_ms` (tail latency
+    over the whole chaos window), both born lower-is-better for
+    benchdiff gating."""
+    from mmlspark_tpu.control import (RolloutConfig, RolloutDriver,
+                                      WeightedRouter)
+    from mmlspark_tpu.core import Table
+    from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.registry import (ServiceRegistry,
+                                          report_server_to_registry)
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.reliability.metrics import reliability_metrics
+    from mmlspark_tpu.telemetry import lineage as tlineage
+    from mmlspark_tpu.telemetry import slo as tslo
+    from mmlspark_tpu.telemetry.exposition import scrape_cluster
+
+    rng = np.random.default_rng(0)
+    n, f = 8_000, 16
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    incumbent = GBDTClassifier(num_iterations=10, max_depth=4).fit(
+        Table({"features": x, "label": y}))
+    body = json.dumps({"features": [0.1] * f})
+
+    # short SLO windows so the candidate's burn — and the post-rollback
+    # recovery — both land inside the bench run (2 s short / 4 s long)
+    tslo.configure(objectives=[tslo.Objective(
+        name="serving.error_rate", kind=tslo.ERROR_RATE,
+        metric="serving.request.errors",
+        total_metric="serving.request.total",
+        budget=0.02, window_s=2.0)], long_factor=2.0)
+    reliability_metrics.reset()
+    tlineage.reset_version_registry()
+
+    n_workers = 3
+    registry = ServiceRegistry(ttl_s=30.0).start()
+    fleet = []     # (server, query)
+    try:
+        for i in range(n_workers):
+            server, q = serve_pipeline(incumbent, input_cols=["features"],
+                                       mode="microbatch", max_batch=128,
+                                       fast_path=True)
+            host, port = server._httpd.server_address[:2]
+            report_server_to_registry(registry.address, "serving", host,
+                                      port, process_id=i,
+                                      version=q.transform_fn.version)
+            fleet.append((server, q))
+        router = WeightedRouter(registry.address, "serving")
+
+        # -- phase A: steady-state fleet throughput through the router ---
+        res_a = run_load("", 0, body, n_clients=8, per_client=150,
+                         post=lambda b: router.post(b.encode()))
+        assert not res_a.errors, res_a.errors[:3]
+        router.update_from_scrape(
+            scrape_cluster(registry.address, window=30.0))
+
+        # -- phase B: poison candidate mid-load, auto-rollback -----------
+        driver = RolloutDriver(
+            workers={f"w{i}": q.transform_fn
+                     for i, (_, q) in enumerate(fleet)},
+            incumbent=incumbent, candidate=_PoisonModel(),
+            registry_address=registry.address,
+            config=RolloutConfig(traffic_steps=(1.0 / n_workers, 1.0),
+                                 step_polls=2, soak_polls=2,
+                                 poll_interval_s=0.3,
+                                 scrape_window_s=10.0, recover_polls=40))
+        status = {}
+        rollout = threading.Thread(
+            target=lambda: status.update(driver.run()), daemon=True)
+        any_answer = lambda s, p: None   # noqa: E731 - 502s are answers
+        t0 = time.perf_counter()
+        rollout.start()
+        res_b = run_load("", 0, body, n_clients=8, per_client=400,
+                         check=any_answer,
+                         post=lambda b: router.post(b.encode()))
+        rollout.join(timeout=60)
+        chaos_wall = time.perf_counter() - t0
+        snap = scrape_cluster(registry.address, slo=True)
+    finally:
+        for server, q in fleet:
+            q.stop()
+            server.stop()
+        registry.stop()
+        tslo.configure()   # restore default objectives
+
+    assert status.get("state") == "rolled_back", status
+    assert res_b.n_dropped == 0, \
+        f"{res_b.n_dropped} of {res_b.n_sent} requests dropped in rollback"
+    assert snap.slo is not None and snap.slo["ok"] and \
+        not snap.slo["burning"], "fleet /slo never recovered"
+    errs_502 = res_b.n_by_status.get(502, 0)
+    assert errs_502 > 0, "poison candidate never produced a 502 burn"
+
+    print(json.dumps({
+        "metric": "fleet_req_per_sec",
+        "value": round(res_a.req_per_sec, 1), "unit": "req/s",
+        "vs_baseline": 0.0,
+        "workers": n_workers,
+        "rollback_window_p99_ms": round(res_b.p99_ms, 2),
+        "requests_dropped": res_b.n_dropped,
+        "rollback_state": status.get("state"),
+        "chaos_wall_s": round(chaos_wall, 2),
+        "chaos_answered": res_b.n_answered,
+        "chaos_502": errs_502,
+        "router_weights": router.weights}))
+
+
 def _bench_ckpt():
     """Checkpoint stall per training step, sync vs async (ISSUE 4
     tooling satellite): the SAME LM stream-training loop runs (a) with no
@@ -1444,6 +1579,8 @@ def main():
         return _bench_telemetry()
     if mode == "quality":
         return _bench_quality()
+    if mode == "fleet":
+        return _bench_fleet()
     if mode == "hist":
         return _bench_hist()
     # predict/shap modes never print the bandwidth fields — don't spend the

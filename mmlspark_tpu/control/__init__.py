@@ -1,0 +1,37 @@
+"""Serving control plane: the ACTUATION tier over serving, registry, and
+telemetry.
+
+The observability arc (canary verdicts, SLO burn rates, per-worker
+queue-depth/p99 gauges, fleet scrape/merge) built the sensors; nothing
+acted on them. This package closes the loop:
+
+- `rollout` — progressive delivery: `RolloutDriver` installs a candidate
+  model on staged traffic fractions, watches the fleet's canary/SLO
+  verdicts through a deterministic state machine, and auto-promotes or
+  auto-rolls-back (idempotent, retry-bounded) with every transition
+  journaled to the RunLedger and emitted as `control.rollout.*` events.
+- `actuators` — fleet actuators: `WeightedRouter` (target selection
+  weighted by scraped queue depth and windowed p99), `BurnAwareAdmission`
+  (shed-before-queue with Retry-After while the error budget burns), and
+  `FleetScaler` (occupancy-driven drain/spawn hooks over the existing
+  per-worker graceful drain).
+
+Everything here is host-side control logic — pure Python over the
+telemetry/serving substrates, no compiled hot path (pinned by
+tests/test_control.py: importing this package must not import jax).
+See docs/control.md.
+"""
+from .actuators import BurnAwareAdmission, FleetScaler, WeightedRouter
+from .rollout import (Action, Observation, RolloutConfig, RolloutDriver,
+                      RolloutStateMachine)
+
+__all__ = [
+    "Action",
+    "BurnAwareAdmission",
+    "FleetScaler",
+    "Observation",
+    "RolloutConfig",
+    "RolloutDriver",
+    "RolloutStateMachine",
+    "WeightedRouter",
+]

@@ -21,10 +21,12 @@ from __future__ import annotations
 import http.client
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler
 from typing import NamedTuple, Optional
 
+from ..reliability.metrics import reliability_metrics
 from ..reliability.policy import RetryPolicy
 from ..telemetry.spans import get_tracer
 from ..telemetry import names as tnames
@@ -134,10 +136,23 @@ class _RegistryHandler(BaseHTTPRequestHandler):
 
 
 class ServiceRegistry:
-    """The leader-side registry service (DriverServiceUtils analog)."""
+    """The leader-side registry service (DriverServiceUtils analog).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    `ttl_s` arms stale-entry expiry: every registration (a worker's
+    periodic re-`report_server_to_registry` IS its heartbeat) refreshes
+    the entry's `last_seen` stamp, and an entry not refreshed within
+    `ttl_s` is evicted on the next read (`registry.evictions`) — the
+    routing tier never weighs a worker that stopped heartbeating. The
+    default (None) keeps the legacy forever-registration, and the WIRE
+    is unchanged either way: a TTL-less client's registration body still
+    parses (expiry is registry-side state, not a protocol field)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ttl_s: Optional[float] = None, clock=time.monotonic):
+        self.ttl_s = ttl_s
+        self._clock = clock
         self._services: dict = {}   # (name, host, port) -> ServiceInfo
+        self._last_seen: dict = {}  # (name, host, port) -> clock() stamp
         self._lock = threading.Lock()
         self._httpd = _ThreadingServer((host, port), _RegistryHandler)
         self._httpd.registry = self  # type: ignore
@@ -164,13 +179,35 @@ class ServiceRegistry:
 
     def _put(self, info: ServiceInfo):
         with self._lock:
-            self._services[(info.name, info.host, info.port)] = info
+            key = (info.name, info.host, info.port)
+            self._services[key] = info
+            # re-registration refreshes the heartbeat stamp: the SAME
+            # (name, host, port) posting again is a liveness signal,
+            # not a new worker
+            self._last_seen[key] = self._clock()
 
     def _remove(self, name: str, host: str, port: int):
         with self._lock:
             self._services.pop((name, host, port), None)
+            self._last_seen.pop((name, host, port), None)
+
+    def _evict_stale(self):
+        """TTL expiry at read time (no sweeper thread: a registry nobody
+        reads has nobody to mislead). One eviction counted per entry."""
+        if self.ttl_s is None:
+            return
+        now = self._clock()
+        with self._lock:
+            stale = [k for k, seen in self._last_seen.items()
+                     if now - seen > self.ttl_s]
+            for key in stale:
+                self._services.pop(key, None)
+                self._last_seen.pop(key, None)
+        for _ in stale:
+            reliability_metrics.inc(tnames.REGISTRY_EVICTIONS)
 
     def services(self, name: Optional[str] = None):
+        self._evict_stale()
         with self._lock:
             vals = list(self._services.values())
         return [v for v in vals if name is None or v.name == name]

@@ -73,7 +73,8 @@ class CachedRequest:
     """One held HTTP exchange (reference: CachedRequest, HTTPSourceV2.scala:519)."""
 
     __slots__ = ("id", "body", "headers", "path", "_event", "_response",
-                 "_on_respond", "t_enqueue", "span", "slo", "version")
+                 "_on_respond", "t_enqueue", "span", "slo", "version",
+                 "retry_after")
 
     def __init__(self, body: bytes, headers: dict, path: str,
                  on_respond=None):
@@ -89,6 +90,7 @@ class CachedRequest:
         self.slo = False                # counted in serving.request.*
         #                                 (exposition self-scrapes are not)
         self.version = None             # X-Model-Version response stamp
+        self.retry_after = None         # Retry-After seconds on a shed 503
 
     def respond(self, status: int, body: bytes,
                 content_type: str = "application/json"):
@@ -173,6 +175,10 @@ class _Handler(BaseHTTPRequestHandler):
         if cached.version is not None:
             # which ModelVersion answered (hot-swap attribution)
             self.send_header("X-Model-Version", cached.version)
+        if cached.retry_after is not None:
+            # burn-aware shed: tell the client WHEN to come back instead
+            # of letting it hammer a burning budget (RFC 9110 §10.2.3)
+            self.send_header("Retry-After", str(int(cached.retry_after)))
         self.end_headers()
         self.wfile.write(payload)
 
@@ -514,15 +520,21 @@ class _SelectorServer:
             out.append(_response_head(status, ctype))
             # X-Request-Id echoes the server-side correlation id (== the
             # root span id) so the client can quote it against traces;
-            # X-Model-Version names the ModelVersion that answered
-            if req.version is not None:
-                out.append(
-                    b"%d\r\nX-Request-Id: %b\r\nX-Model-Version: %b\r\n\r\n"
-                    % (len(payload), req.id.encode("latin-1"),
-                       req.version.encode("latin-1")))
-            else:
+            # X-Model-Version names the ModelVersion that answered;
+            # Retry-After rides burn-aware shed 503s
+            if req.version is None and req.retry_after is None:
+                # common-case fast path: one format, no concatenation
                 out.append(b"%d\r\nX-Request-Id: %b\r\n\r\n"
                            % (len(payload), req.id.encode("latin-1")))
+            else:
+                head = b"%d\r\nX-Request-Id: %b" % (
+                    len(payload), req.id.encode("latin-1"))
+                if req.version is not None:
+                    head += (b"\r\nX-Model-Version: %b"
+                             % req.version.encode("latin-1"))
+                if req.retry_after is not None:
+                    head += b"\r\nRetry-After: %d" % int(req.retry_after)
+                out.append(head + b"\r\n\r\n")
             out.append(payload)
         if out:
             conn.wbuf += b"".join(out)
@@ -662,7 +674,8 @@ class ServingServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  num_partitions: int = 1, reply_timeout: float = 30.0,
                  transport: str = "selector", max_queue: int = 1024,
-                 faults: Optional[FaultInjector] = None):
+                 faults: Optional[FaultInjector] = None,
+                 admission=None):
         if transport not in ("selector", "threading"):
             raise ValueError("transport must be selector|threading")
         self.num_partitions = num_partitions
@@ -671,6 +684,11 @@ class ServingServer:
         # immediately instead of growing without bound (heavy-traffic
         # ingress must fail fast, not queue into certain 504s)
         self.max_queue = max_queue
+        # burn-aware admission controller (control/actuators.py): when the
+        # error budget is burning, shed-before-queue with Retry-After
+        # instead of queueing up to max_queue. None = legacy behavior.
+        # Mutable post-start: the control plane may arm it on a live server.
+        self.admission = admission
         # deterministic fault injection (None = zero-overhead disabled);
         # falls back to the MMLSPARK_TPU_FAULTS env spec
         self._faults = faults if faults is not None else FaultInjector.from_env()
@@ -784,6 +802,19 @@ class ServingServer:
             req.respond(503, b'{"error": "server draining"}')
             return
         pid = next(self._rr) % self.num_partitions
+        admission = self.admission
+        if admission is not None \
+                and admission.should_shed(self._queues[pid].qsize()):
+            # burn-aware shed-BEFORE-queue: while the error budget burns,
+            # a request that would have to wait behind queued work is
+            # refused immediately with Retry-After — queueing it would
+            # spend budget on a reply that arrives late anyway, and the
+            # explicit back-off is what lets the fleet recover
+            reliability_metrics.inc(tnames.SERVING_SHED_REQUESTS)
+            reliability_metrics.inc(tnames.CONTROL_ADMISSION_SHED)
+            req.retry_after = admission.retry_after_s
+            req.respond(503, b'{"error": "error budget burning"}')
+            return
         if self.max_queue and self._queues[pid].qsize() >= self.max_queue:
             # load shedding: a queue past the bound means every enqueued
             # request is already doomed to time out — shed NOW with 503 so
@@ -1094,7 +1125,7 @@ def serve_pipeline(model, input_cols, output_col: str = "prediction",
                    host: str = "127.0.0.1", port: int = 0,
                    num_partitions: int = 1, mode: str = "microbatch",
                    max_batch: int = 64, batch_linger_ms: float = 0.0,
-                   fast_path: bool = True, faults=None):
+                   fast_path: bool = True, faults=None, admission=None):
     """One-call serving of a fitted PipelineModel: JSON rows in, scored
     column out (reference: the readStream.server().load() ->
     pipeline -> writeStream.server() composition, IOImplicits.scala).
@@ -1113,8 +1144,12 @@ def serve_pipeline(model, input_cols, output_col: str = "prediction",
     `faults` arms the transform's `serving.swap` chaos site (a
     mid-`install_model` fault rolls back to the incumbent); hot-swap a
     retrained model with `query.transform_fn.install_model(new_model)`
-    — zero dropped requests (docs/serving.md "Hot-swap & canary")."""
-    server = ServingServer(host, port, num_partitions).start()
+    — zero dropped requests (docs/serving.md "Hot-swap & canary").
+    `admission` mounts a burn-aware admission controller
+    (control/actuators.BurnAwareAdmission): shed-before-queue with
+    Retry-After while the error budget burns (docs/control.md)."""
+    server = ServingServer(host, port, num_partitions,
+                           admission=admission).start()
 
     if fast_path:
         from .plan import compile_serving_transform

@@ -27,6 +27,14 @@ records — a kernel "win" that tanked either fails the diff:
 
     python -m mmlspark_tpu.telemetry.benchdiff --threshold 0.1 BENCH_r*.json
 
+Fleet control-loop gates (round 16): every ``fleet_req_per_sec`` record
+(BENCH_MODE=fleet — loadgen through the weighted router with a poison
+candidate auto-rolled-back mid-run) additionally synthesizes
+``fleet.rollback_window_p99_ms`` and ``fleet.requests_dropped``, both
+born ``lower_better`` — a round that stretched the chaos-window tail or
+dropped even one request during rollback fails the diff regardless of
+throughput.
+
 Backend gating (round 11): records carry a ``backend`` annotation (from
 the record itself, or a round file's top-level ``backend`` declaration —
 bench.py stamps ``jax.default_backend()``); records measured on a
@@ -144,8 +152,34 @@ def _gbdt_records(rec: dict) -> list:
     return out
 
 
+# fields of the BENCH_MODE=fleet headline record that gate as first-class
+# LOWER-IS-BETTER metrics: the chaos window's tail latency and the
+# zero-drop acceptance count (any value above 0 is a regression, and a
+# round that drops requests must fail the diff even if req/s improved)
+_FLEET_METRIC = "fleet_req_per_sec"
+_FLEET_LOWER_FIELDS = ("rollback_window_p99_ms", "requests_dropped")
+
+
+def _fleet_records(rec: dict) -> list:
+    """Derived gate records from one fleet-bench headline record (born
+    ``lower_better``); the parent's backend annotation rides along."""
+    if rec.get("metric") != _FLEET_METRIC:
+        return []
+    out = []
+    for field in _FLEET_LOWER_FIELDS:
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            d = {"metric": f"fleet.{field}", "value": float(v),
+                 "lower_better": True}
+            if rec.get("backend") is not None:
+                d["backend"] = rec["backend"]
+            out.append(d)
+    return out
+
+
 def _with_derived(records: list) -> list:
-    return records + [d for r in records for d in _gbdt_records(r)]
+    return records + [d for r in records
+                      for d in _gbdt_records(r) + _fleet_records(r)]
 
 
 def _records_from_text(text: str) -> list:

@@ -109,6 +109,15 @@ class RunLedger:
         finally:
             os.close(fd)
 
+    def append_event(self, event: str, **attrs) -> dict:
+        """Journal one named control-plane event (rollout transitions,
+        promotions, rollbacks) with a wall-clock stamp. File order IS the
+        sequence — append is a single O_APPEND write, so a reader can pin
+        `deploy < burn < rollback < recovered` by line position alone."""
+        record = {"event": event, "t": wall_now(), **attrs}
+        self.append(record)
+        return record
+
     def records(self) -> list:
         if not os.path.exists(self.path):
             return []

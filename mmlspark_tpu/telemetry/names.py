@@ -80,6 +80,16 @@ QUALITY_LABELS_DROPPED = "quality.labels.dropped"
 QUALITY_SKETCH_ROWS = "quality.sketch.rows"
 SERVING_MODEL_SWAPS = "serving.model.swaps"
 SERVING_MODEL_SWAP_ERRORS = "serving.model.swap_errors"
+REGISTRY_EVICTIONS = "registry.evictions"
+CONTROL_ROLLOUT_STEPS = "control.rollout.steps"
+CONTROL_ROLLOUT_PROMOTIONS = "control.rollout.promotions"
+CONTROL_ROLLOUT_ROLLBACKS = "control.rollout.rollbacks"
+CONTROL_ROLLOUT_ROLLBACK_RETRIES = "control.rollout.rollback_retries"
+CONTROL_ROLLOUT_POLL_ERRORS = "control.rollout.poll_errors"
+CONTROL_ADMISSION_SHED = "control.admission.shed"
+CONTROL_ROUTER_UPDATES = "control.router.updates"
+CONTROL_SCALER_SPAWNS = "control.scaler.spawns"
+CONTROL_SCALER_DRAINS = "control.scaler.drains"
 
 COUNTERS = {
     SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
@@ -168,6 +178,28 @@ COUNTERS = {
                          "version's plans drain, never invalidate)",
     SERVING_MODEL_SWAP_ERRORS: "install_model swaps that failed and "
                                "rolled back to the incumbent handle",
+    REGISTRY_EVICTIONS: "registry entries evicted because no "
+                        "re-registration heartbeat landed within the TTL",
+    CONTROL_ROLLOUT_STEPS: "candidate traffic-step installs performed by "
+                           "the rollout driver (one per staged fraction)",
+    CONTROL_ROLLOUT_PROMOTIONS: "rollouts auto-promoted after a clean "
+                                "soak window",
+    CONTROL_ROLLOUT_ROLLBACKS: "rollouts auto-rolled-back to the "
+                               "incumbent (burn or watch trip)",
+    CONTROL_ROLLOUT_ROLLBACK_RETRIES: "rollback install_model attempts "
+                                      "retried under the driver's "
+                                      "RetryPolicy",
+    CONTROL_ROLLOUT_POLL_ERRORS: "rollout-driver fleet scrapes that "
+                                 "failed (absorbed; the round is skipped)",
+    CONTROL_ADMISSION_SHED: "requests shed 503+Retry-After by burn-aware "
+                            "admission (error budget burning, queue "
+                            "non-empty)",
+    CONTROL_ROUTER_UPDATES: "weighted-router weight table refreshes from "
+                            "fleet scrapes",
+    CONTROL_SCALER_SPAWNS: "spawn hooks fired by the occupancy-driven "
+                           "fleet scaler",
+    CONTROL_SCALER_DRAINS: "drain hooks fired by the occupancy-driven "
+                           "fleet scaler",
     "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
                              "(process/thread)",
     "gbdt.hist.route.{route}": "histogram kernel-route selections "
@@ -200,6 +232,7 @@ SERVING_MODEL_VERSION_INFO = "serving.model.version_info"
 CANARY_P99_RATIO = "canary.p99.ratio"
 CANARY_ERROR_BURN = "canary.error_burn"
 CANARY_DRIFT_DELTA = "canary.drift.delta"
+CONTROL_ROLLOUT_FRACTION = "control.rollout.fraction"
 
 GAUGES = {
     ANALYSIS_SEMANTIC_CONTRACTS: "hot-path contracts analyzed by the last "
@@ -242,6 +275,13 @@ GAUGES = {
                        "budget (absent until a swap installs a candidate)",
     CANARY_DRIFT_DELTA: "candidate live quality.drift.max minus the "
                         "incumbent's frozen drift at swap time",
+    CONTROL_ROLLOUT_FRACTION: "traffic fraction the rollout driver "
+                              "currently targets for the candidate "
+                              "(0 after rollback, 1 at/after promote)",
+    "control.router.weight.{target}": "weighted-router relative weight "
+                                      "per target (host:port), 1..100 — "
+                                      "scaled from scraped queue depth "
+                                      "and windowed p99",
     "quality.drift.{col}": "per-column PSI drift, reference vs live "
                            "sketch counts over the shared bucket grid "
                            "(refreshed on every exposition scrape)",
@@ -353,6 +393,12 @@ TELEMETRY_BUNDLE_EVENT = "telemetry.bundle"
 TELEMETRY_PROFILE_EVENT = "telemetry.profile"
 TELEMETRY_WATCH_TRIP_EVENT = "telemetry.watch.trip"
 SERVING_MODEL_SWAP_EVENT = "serving.model.swap"
+CONTROL_ROLLOUT_DEPLOY_EVENT = "control.rollout.deploy"
+CONTROL_ROLLOUT_STEP_EVENT = "control.rollout.step"
+CONTROL_ROLLOUT_BURN_EVENT = "control.rollout.burn"
+CONTROL_ROLLOUT_PROMOTE_EVENT = "control.rollout.promote"
+CONTROL_ROLLOUT_ROLLBACK_EVENT = "control.rollout.rollback"
+CONTROL_ROLLOUT_RECOVERED_EVENT = "control.rollout.recovered"
 
 EVENTS = {
     FAULT_INJECTED_EVENT: "one FaultInjector firing (site, index, kind)",
@@ -373,6 +419,20 @@ EVENTS = {
     SERVING_MODEL_SWAP_EVENT: "one committed install_model hot-swap "
                               "(old/new version ids, plan-cache size "
                               "attrs)",
+    CONTROL_ROLLOUT_DEPLOY_EVENT: "rollout started: candidate installed "
+                                  "on the first traffic step (candidate/"
+                                  "incumbent version, fraction attrs)",
+    CONTROL_ROLLOUT_STEP_EVENT: "rollout advanced one traffic step "
+                                "(fraction, workers attrs)",
+    CONTROL_ROLLOUT_BURN_EVENT: "rollout observed a burn or watch trip — "
+                                "the rollback trigger (reason attr)",
+    CONTROL_ROLLOUT_PROMOTE_EVENT: "rollout auto-promoted the candidate "
+                                   "after its soak window",
+    CONTROL_ROLLOUT_ROLLBACK_EVENT: "rollout re-installed the incumbent "
+                                    "fleet-wide (reason, workers attrs)",
+    CONTROL_ROLLOUT_RECOVERED_EVENT: "post-rollback fleet SLO verdict "
+                                     "returned to ok (ok attr False when "
+                                     "the wait timed out)",
     "registry.{action}": "registry HTTP hops (register/unregister) under "
                          "the caller's propagated trace",
 }
@@ -402,6 +462,10 @@ FAULT_SITES = {
                     "handle is built but before it commits (a raise "
                     "rolls back to the incumbent — counted "
                     "serving.model.swap_errors)",
+    "control.rollout.poll": "RolloutDriver fleet scrape, fired before "
+                            "each poll round (kind `error` counts "
+                            "control.rollout.poll_errors and skips the "
+                            "round; `delay` stretches the poll)",
 }
 
 
@@ -464,3 +528,8 @@ def quality_drift(col: str) -> str:
 def quality_eval(metric: str) -> str:
     """quality.eval.{metric} — streaming-evaluation metric gauge."""
     return f"quality.eval.{metric}"
+
+
+def control_router_weight(target: str) -> str:
+    """control.router.weight.{target} — per-target router weight gauge."""
+    return f"control.router.weight.{target}"

@@ -65,7 +65,7 @@ class TelemetryPoller:
                  jsonl_path: Optional[str] = None,
                  jsonl_max_bytes: int = 16 * 1024 * 1024,
                  clock=None, quality: bool = False,
-                 versions: bool = False):
+                 versions: bool = False, on_sample=None):
         if interval_s <= 0.0:
             raise ValueError("interval_s must be > 0")
         self.registry_address = registry_address
@@ -104,6 +104,12 @@ class TelemetryPoller:
         # poller is the one process that sees the fleet burn even when no
         # single worker does
         self.flight_on_burn = bool(flight_on_burn)
+        # actuator hook: called with (sample, snapshot) after each poll
+        # round — the control loop's feed (e.g. a WeightedRouter's
+        # update_from_scrape, a FleetScaler's observe). Exceptions are
+        # absorbed as poll errors: an actuator bug leaves a gap in
+        # actuation, never a dead poller.
+        self.on_sample = on_sample
         self._samples: deque = deque(maxlen=max(int(history), 1))
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -180,6 +186,11 @@ class TelemetryPoller:
                     snap.slo, reason="fleet-slo-burn", source="fleet")
             except Exception:  # noqa: BLE001 - the series continues
                 pass
+        if self.on_sample is not None:
+            try:
+                self.on_sample(sample, snap)
+            except Exception:  # noqa: BLE001 - actuators never kill polls
+                reliability_metrics.inc(tnames.TELEMETRY_POLL_ERRORS)
         return sample
 
     # -- read side -----------------------------------------------------------

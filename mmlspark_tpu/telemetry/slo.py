@@ -287,6 +287,15 @@ def _finish_window(obj: dict, m: dict) -> dict:
     return m
 
 
+def verdict_burning(verdict: Optional[dict]) -> bool:
+    """None-safe read of a verdict's fleet-level `burning` flag — the
+    one-liner every actuator (burn-aware admission, the rollout driver)
+    keys on. A missing/empty verdict reads NOT burning: actuation must
+    fail open (keep serving) when the sensor is dark, never shed on a
+    scrape gap."""
+    return bool(verdict) and bool(verdict.get("burning"))
+
+
 def merge_verdicts(verdicts: list) -> Optional[dict]:
     """Fleet-wide verdict from per-worker verdicts: per-objective,
     per-window counts SUM across workers and rates/burns are recomputed

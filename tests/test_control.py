@@ -1,0 +1,894 @@
+"""Serving control loop (ISSUE 16): progressive delivery with
+chaos-proven auto-rollback, and SLO-burn-aware fleet actuators.
+
+Pins the new contracts: the rollout state machine is a PURE function of
+its observations (promote, rollback-on-burn, rollback-on-watch-trip —
+no sockets, seeded schedules); the driver's rollback is retry-bounded
+(a seeded `serving.swap` fault mid-rollback retries until the incumbent
+serves) and IDEMPOTENT (a double rollback is a no-op — no extra swaps,
+no extra journal entries); a scrape fault at the seeded
+`control.rollout.poll` site skips the round, never kills the loop; the
+closed-loop fleet harness auto-rolls-back a poison candidate under live
+load with ZERO dropped requests, the ledger pinning
+deploy < burn < rollback < recovered, and the fleet `/slo` back to ok —
+while a healthy candidate auto-promotes through the staged path. The
+actuators: SWRR routing shares follow the weight table (a delay-faulted
+worker's share drops), burn-aware admission sheds 503+Retry-After
+BEFORE queueing, and the occupancy scaler's decide/observe policy is
+deterministic. Registry TTL eviction keeps the wire unchanged, and the
+control package never imports jax (no compiled hot path — the graftsem
+assert-none contract)."""
+import collections
+import functools
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.control import (Action, BurnAwareAdmission, FleetScaler,
+                                  Observation, RolloutConfig, RolloutDriver,
+                                  RolloutStateMachine, WeightedRouter)
+from mmlspark_tpu.control import rollout as ctl
+from mmlspark_tpu.core import Table
+from mmlspark_tpu.reliability.faults import FaultInjector
+from mmlspark_tpu.reliability.metrics import reliability_metrics
+from mmlspark_tpu.telemetry import lineage as tlineage
+from mmlspark_tpu.telemetry import names as tnames
+from mmlspark_tpu.telemetry import slo as tslo
+
+from benchmarks import measure_quiet
+
+
+@pytest.fixture
+def control_state():
+    """Fresh metrics + version registry + default SLO objectives. Also
+    clears the process-global CompileLog: these tests compile serving
+    transforms from the same cached models repeatedly, and leaving
+    their (fingerprint, bucket) keys behind would read as recompiles to
+    later zero-recompile tests."""
+    from mmlspark_tpu.telemetry import perf
+    reliability_metrics.reset()
+    tlineage.reset_version_registry()
+    tlineage.configure_run_ledger(None)
+    tslo.configure()
+    perf.get_compile_log().clear()
+    yield
+    perf.get_compile_log().clear()
+    tslo.configure()
+    tlineage.configure_run_ledger(None)
+    tlineage.reset_version_registry()
+    reliability_metrics.reset()
+
+
+@functools.lru_cache(maxsize=None)
+def _fit(seed=0, n=400, f=5, iters=4):
+    """One fitted booster; different seeds -> distinct content digests.
+    Cached: the fitted model is read-only in every test (installs copy
+    nothing), and refitting per test would dominate the file's wall
+    clock."""
+    from mmlspark_tpu.models.gbdt.estimators import GBDTClassifier
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    model = GBDTClassifier(num_iterations=iters, max_depth=3).fit(
+        Table({"features": x, "label": y}))
+    return model
+
+
+class _PoisonModel:
+    """A candidate whose artifact cannot score: versions fine, installs
+    fine, and raises server-side (-> 502s) on every batch."""
+
+    def transform(self, table):
+        raise RuntimeError("bad candidate: artifact cannot score")
+
+    def _get_state(self):
+        return {"poison": np.asarray([1.0], np.float32)}
+
+
+# ------------------------------------------------ pure state machine
+def test_state_machine_promotes_through_staged_path():
+    """Healthy observations walk canary steps -> soak -> promoted, and
+    the action sequence is a deterministic function of the schedule."""
+    sm = RolloutStateMachine(RolloutConfig(
+        traffic_steps=(0.25, 0.5, 1.0), step_polls=2, soak_polls=3))
+    actions = [sm.start()]
+    assert actions[0] == Action("install", fraction=0.25)
+    assert sm.state == ctl.CANARY and sm.fraction == 0.25
+    for _ in range(20):
+        if sm.state == ctl.PROMOTED:
+            break
+        a = sm.on_observation(Observation())
+        if a is not None:
+            actions.append(a)
+    assert [a.kind for a in actions] == ["install", "install", "install",
+                                         "promote"]
+    assert [a.fraction for a in actions[:3]] == [0.25, 0.5, 1.0]
+    assert sm.state == ctl.PROMOTED and sm.fraction == 1.0
+    # observations after a terminal state are inert
+    assert sm.on_observation(Observation(burning=True)) is None
+    assert sm.state == ctl.PROMOTED
+
+
+def test_state_machine_rolls_back_on_burn_and_on_watch_trip():
+    for obs, reason in ((Observation(burning=True), "burn"),
+                        (Observation(tripped=True), "watch-trip")):
+        sm = RolloutStateMachine(RolloutConfig(traffic_steps=(0.5, 1.0)))
+        sm.start()
+        sm.on_observation(Observation())          # healthy, stays canary
+        a = sm.on_observation(obs)
+        assert a == Action("rollback", reason=reason)
+        assert sm.state == ctl.ROLLING_BACK and sm.fraction == 0.0
+        # mid-rollback observations are inert (half the idempotency)
+        assert sm.on_observation(Observation(burning=True)) is None
+        sm.on_rollback_result(True)
+        assert sm.state == ctl.ROLLED_BACK
+        # a second rollback result is a no-op
+        sm.on_rollback_result(False)
+        assert sm.state == ctl.ROLLED_BACK
+
+
+def test_state_machine_failed_rollback_is_terminal():
+    sm = RolloutStateMachine()
+    sm.start()
+    sm.on_observation(Observation(burning=True))
+    sm.on_rollback_result(False)
+    assert sm.state == ctl.FAILED
+    assert sm.on_observation(Observation()) is None
+
+
+def test_state_machine_config_validation():
+    with pytest.raises(ValueError):
+        RolloutStateMachine(RolloutConfig(traffic_steps=(0.25, 0.5)))
+    with pytest.raises(ValueError):
+        RolloutStateMachine(RolloutConfig(traffic_steps=(0.5, 0.25, 1.0)))
+    with pytest.raises(ValueError):
+        RolloutStateMachine(RolloutConfig(traffic_steps=(0.0, 1.0)))
+    with pytest.raises(ValueError):
+        RolloutStateMachine(RolloutConfig(step_polls=0))
+    sm = RolloutStateMachine()
+    sm.start()
+    with pytest.raises(RuntimeError):
+        sm.start()
+
+
+# ------------------------------------------------ driver (no sockets)
+class _FakeTransform:
+    """install_model recorder with the transform surface the driver
+    needs; `fail_installs` makes the next N installs raise."""
+
+    def __init__(self, model):
+        self.installs = []
+        self.fail_installs = 0
+        self._model = model
+        self.version = tlineage.model_version(model).version
+
+    def install_model(self, model, if_changed=False):
+        mv = tlineage.model_version(model)
+        if if_changed and mv.version == self.version:
+            return {"old": self.version, "new": self.version,
+                    "unchanged": True}
+        if self.fail_installs > 0:
+            self.fail_installs -= 1
+            raise RuntimeError("swap failed")
+        self.installs.append(mv.version)
+        old, self.version = self.version, mv.version
+        self._model = model
+        return {"old": old, "new": mv.version}
+
+
+def _driver(workers, incumbent, candidate, schedule, tmp_path=None,
+            **cfg_kw):
+    """Driver with an injected observation schedule and no real sleeps."""
+    sched = iter(schedule)
+    ledger = (tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+              if tmp_path is not None else None)
+    cfg = RolloutConfig(**{"poll_interval_s": 0.0, "recover_polls": 2,
+                           **cfg_kw})
+    return RolloutDriver(
+        workers, incumbent, candidate, observe=lambda: next(sched),
+        config=cfg, ledger=ledger, sleep=lambda s: None)
+
+
+def test_driver_promotes_healthy_candidate(control_state, tmp_path):
+    inc, cand = _fit(0), _fit(1)
+    workers = {f"w{i}": _FakeTransform(inc) for i in range(4)}
+    drv = _driver(workers, inc, cand,
+                  schedule=[Observation()] * 30, tmp_path=tmp_path,
+                  traffic_steps=(0.25, 0.5, 1.0), step_polls=1,
+                  soak_polls=1)
+    status = drv.run()
+    assert status["state"] == ctl.PROMOTED
+    assert status["candidate_on"] == ["w0", "w1", "w2", "w3"]
+    for t in workers.values():
+        assert t.version == drv.candidate_version
+    assert reliability_metrics.get(tnames.CONTROL_ROLLOUT_PROMOTIONS) == 1
+    # staged installs: w0 at 0.25, w1 at 0.5, w2+w3 at 1.0
+    events = [r["event"] for r in drv._ledger.records()
+              if "event" in r]
+    assert events.index(tnames.CONTROL_ROLLOUT_DEPLOY_EVENT) \
+        < events.index(tnames.CONTROL_ROLLOUT_PROMOTE_EVENT)
+
+
+def test_driver_rolls_back_on_burn_and_is_idempotent(control_state,
+                                                     tmp_path):
+    inc, cand = _fit(0), _fit(1)
+    workers = {"w0": _FakeTransform(inc), "w1": _FakeTransform(inc)}
+    drv = _driver(workers, inc, cand,
+                  schedule=[Observation(), Observation(burning=True),
+                            Observation(), Observation()],
+                  tmp_path=tmp_path, traffic_steps=(0.5, 1.0),
+                  step_polls=2)
+    status = drv.run()
+    assert status["state"] == ctl.ROLLED_BACK
+    assert status["candidate_on"] == []
+    assert workers["w0"].version == drv.incumbent_version
+    assert reliability_metrics.get(tnames.CONTROL_ROLLOUT_ROLLBACKS) == 1
+    swaps_before = workers["w0"].installs[:]
+    # double rollback: immediate True, no extra installs, no extra count
+    assert drv.rollback() is True
+    assert workers["w0"].installs == swaps_before
+    assert reliability_metrics.get(tnames.CONTROL_ROLLOUT_ROLLBACKS) == 1
+    events = [r["event"] for r in drv._ledger.records() if "event" in r]
+    order = [tnames.CONTROL_ROLLOUT_DEPLOY_EVENT,
+             tnames.CONTROL_ROLLOUT_BURN_EVENT,
+             tnames.CONTROL_ROLLOUT_ROLLBACK_EVENT,
+             tnames.CONTROL_ROLLOUT_RECOVERED_EVENT]
+    idx = [events.index(e) for e in order]
+    assert idx == sorted(idx), events
+    assert events.count(tnames.CONTROL_ROLLOUT_ROLLBACK_EVENT) == 1
+
+
+def test_driver_rollback_retries_through_install_failures(control_state):
+    """A rollback install that fails (the serving.swap race) retries
+    under the driver's RetryPolicy until the incumbent serves."""
+    inc, cand = _fit(0), _fit(1)
+    w = _FakeTransform(inc)
+    drv = _driver({"w0": w}, inc, cand,
+                  schedule=[Observation(burning=True), Observation()],
+                  traffic_steps=(1.0,), step_polls=1)
+    w.fail_installs = 0
+    drv.machine.start()
+    drv._install_fraction(1.0)
+    w.fail_installs = 2          # first two rollback attempts fail
+    assert drv.rollback(reason="burn") is True
+    assert w.version == drv.incumbent_version
+    assert reliability_metrics.get(
+        tnames.CONTROL_ROLLOUT_ROLLBACK_RETRIES) >= 2
+    assert drv.machine.state == ctl.ROLLED_BACK
+
+
+def test_driver_rollback_retry_after_serving_swap_fault(control_state):
+    """Against the REAL ServingTransform: the candidate installs, then a
+    seeded `serving.swap` fault fails the rollback's re-install once —
+    the RetryPolicy retries and the incumbent serves again; the retried
+    rollback stays a single counted rollback and `if_changed=True` makes
+    a re-driven rollback a version-identity no-op."""
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    inc, cand = _fit(0), _fit(1)
+    # site occurrences: 0 = candidate install (clean), 1 = rollback
+    # attempt (faulted), 2 = rollback retry (clean)
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "serving.swap", "kind": "error", "at": [1]}])
+    transform = compile_serving_transform(inc, ["features"], faults=inj)
+    drv = _driver({"w0": transform}, inc, cand,
+                  schedule=[Observation(burning=True), Observation()],
+                  traffic_steps=(1.0,), step_polls=1)
+    status = drv.run()
+    assert status["state"] == ctl.ROLLED_BACK
+    assert transform.version == drv.incumbent_version
+    assert reliability_metrics.get(
+        tnames.CONTROL_ROLLOUT_ROLLBACK_RETRIES) >= 1
+    assert reliability_metrics.get(tnames.SERVING_MODEL_SWAP_ERRORS) == 1
+    swaps = reliability_metrics.get(tnames.SERVING_MODEL_SWAPS)
+    # idempotent double rollback on the real transform: version identity
+    # short-circuits before the swap machinery (and the chaos site)
+    assert drv.rollback() is True
+    assert transform.install_model(inc, if_changed=True)["unchanged"]
+    assert reliability_metrics.get(tnames.SERVING_MODEL_SWAPS) == swaps
+
+
+def test_driver_deploy_failure_rolls_back(control_state, tmp_path):
+    """A candidate that cannot even install rolls back whatever fraction
+    carries it — with the ledger order still deploy < burn < rollback."""
+    inc, cand = _fit(0), _fit(1)
+    w = _FakeTransform(inc)
+    w.fail_installs = 10
+    drv = _driver({"w0": w}, inc, cand, schedule=[Observation()] * 4,
+                  tmp_path=tmp_path, traffic_steps=(1.0,), step_polls=1)
+    status = drv.run()
+    assert status["state"] == ctl.ROLLED_BACK
+    assert w.version == drv.incumbent_version
+    events = [r["event"] for r in drv._ledger.records() if "event" in r]
+    order = [tnames.CONTROL_ROLLOUT_DEPLOY_EVENT,
+             tnames.CONTROL_ROLLOUT_BURN_EVENT,
+             tnames.CONTROL_ROLLOUT_ROLLBACK_EVENT]
+    idx = [events.index(e) for e in order]
+    assert idx == sorted(idx), events
+
+
+def test_driver_same_version_candidate_rejected(control_state):
+    inc = _fit(0)
+    with pytest.raises(ValueError):
+        RolloutDriver({"w0": _FakeTransform(inc)}, inc, inc,
+                      observe=lambda: Observation())
+
+
+# ------------------------------------------------ chaos: the poll site
+def test_poll_fault_skips_round_not_loop(control_state):
+    """A fault at the seeded `control.rollout.poll` site turns that poll
+    round into a skip (counted control.rollout.poll_errors) — the next
+    round observes normally."""
+    from mmlspark_tpu.io.registry import (ServiceRegistry,
+                                          report_server_to_registry)
+    from mmlspark_tpu.io.serving import serve_pipeline
+    inj = FaultInjector(seed=3, rules=[
+        {"site": "control.rollout.poll", "kind": "error", "at": [0]}])
+    inc, cand = _fit(0), _fit(1)
+    registry = ServiceRegistry().start()
+    server, q = serve_pipeline(inc, input_cols=["features"])
+    try:
+        host, port = server._httpd.server_address[:2]
+        report_server_to_registry(registry.address, "serving", host, port,
+                                  version=q.transform_fn.version)
+        drv = RolloutDriver({"w0": q.transform_fn}, inc, cand,
+                            registry_address=registry.address,
+                            faults=inj, sleep=lambda s: None)
+        assert drv._observe() is None          # faulted round: skipped
+        assert reliability_metrics.get(
+            tnames.CONTROL_ROLLOUT_POLL_ERRORS) == 1
+        obs = drv._observe()                   # next round observes
+        assert obs is not None and obs.healthy
+    finally:
+        q.stop()
+        server.stop()
+        registry.stop()
+
+
+# ------------------------------------------------ actuators: router
+def _registered_registry(n=2):
+    """A live registry with n fake serving entries (no live servers —
+    selection tests never post)."""
+    from mmlspark_tpu.io.registry import ServiceInfo, ServiceRegistry
+    reg = ServiceRegistry().start()
+    infos = []
+    for i in range(n):
+        info = ServiceInfo(name="serving", host="127.0.0.1",
+                           port=9000 + i, process_id=i, num_partitions=1)
+        reg._put(info)
+        infos.append(info)
+    return reg, infos
+
+
+def test_weighted_router_swrr_follows_weights(control_state):
+    reg, infos = _registered_registry(2)
+    try:
+        router = WeightedRouter(reg.address, "serving")
+        a, b = (f"{i.host}:{i.port}" for i in infos)
+        router.set_weights({a: 300, b: 100})
+        counts = collections.Counter()
+        seq = []
+        for _ in range(8):
+            t = router._next_target()
+            key = f"{t.host}:{t.port}"
+            counts[key] += 1
+            seq.append(key)
+        # exact 3:1 split over two full SWRR cycles, and interleaved:
+        # the heavy target never runs 4+ back-to-back (smoothness)
+        assert counts[a] == 6 and counts[b] == 2
+        assert b in seq[:4] and b in seq[4:]
+        assert reliability_metrics.get(tnames.CONTROL_ROUTER_UPDATES) == 1
+        assert reliability_metrics.peek_gauge(
+            tnames.control_router_weight(a)) == 300.0
+    finally:
+        reg.stop()
+
+
+def test_weighted_router_unweighted_is_round_robin(control_state):
+    reg, infos = _registered_registry(3)
+    try:
+        router = WeightedRouter(reg.address, "serving")
+        seq = [router._next_target().port for _ in range(6)]
+        assert sorted(collections.Counter(seq).values()) == [2, 2, 2]
+    finally:
+        reg.stop()
+
+
+def test_weighted_router_update_from_scrape_costs_queue_and_p99(
+        control_state):
+    """cost = (1 + queue_depth) x max(p99_ms, 1): a worker with a deep
+    queue gets a proportionally smaller share."""
+    from mmlspark_tpu.telemetry.exposition import ClusterSnapshot
+    reg, infos = _registered_registry(2)
+    try:
+        router = WeightedRouter(reg.address, "serving")
+        snap = ClusterSnapshot(
+            merged={},
+            workers=[(infos[0], {"gauges": {"serving.queue_depth": 0}}),
+                     (infos[1], {"gauges": {"serving.queue_depth": 9}})])
+        weights = router.update_from_scrape(snap)
+        a, b = (f"{i.host}:{i.port}" for i in infos)
+        assert weights[a] == 100 and weights[b] == 10
+        counts = collections.Counter()
+        for _ in range(11):
+            t = router._next_target()
+            counts[f"{t.host}:{t.port}"] += 1
+        assert counts[a] == 10 and counts[b] == 1
+    finally:
+        reg.stop()
+
+
+def test_delay_faulted_worker_share_drops_fleet_p99_bounded(control_state):
+    """Actuator acceptance: two live workers, one delay-faulted at the
+    seeded `serving.worker` site; after a scrape-driven weight update the
+    slow worker's share of new requests drops while the fleet keeps
+    answering (p99 floor routed through measure_quiet)."""
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    from mmlspark_tpu.io.registry import (ServiceRegistry,
+                                          report_server_to_registry)
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+    from mmlspark_tpu.telemetry.exposition import scrape_cluster
+
+    inc = _fit(0)
+    body = json.dumps({"features": [0.1] * 5})
+    registry = ServiceRegistry().start()
+    slow_inj = FaultInjector(seed=11, rules=[
+        {"site": "serving.worker", "kind": "delay", "param": 0.04,
+         "prob": 1.0}])
+    fleet = []
+    try:
+        for inj in (None, slow_inj):
+            server = ServingServer(port=0, num_partitions=1,
+                                   faults=inj).start()
+            t = compile_serving_transform(inc, ["features"])
+            q = ServingQuery(server, t, mode="microbatch",
+                             max_batch=32).start()
+            host, port = server._httpd.server_address[:2]
+            report_server_to_registry(registry.address, "serving", host,
+                                      port, version=t.version)
+            fleet.append((server, q, f"{host}:{port}"))
+        fast_addr, slow_addr = fleet[0][2], fleet[1][2]
+        router = WeightedRouter(registry.address, "serving")
+
+        shares = collections.Counter()
+        orig = router._post_target
+
+        def counting_post(t, path, body, ctype):
+            shares[f"{t.host}:{t.port}"] += 1
+            return orig(t, path, body, ctype)
+        router._post_target = counting_post
+
+        def one_round():
+            shares.clear()
+            return run_load("", 0, body, n_clients=4, per_client=24,
+                            post=lambda b: router.post(b.encode()))
+
+        res = one_round()
+        assert not res.errors, res.errors[:3]
+        even_slow_share = shares[slow_addr] / max(res.n_sent, 1)
+
+        # actuate. The live scrape exercises the update_from_scrape path
+        # end-to-end, but in-process workers share ONE metrics registry,
+        # so the scraped per-worker states cannot tell the two apart —
+        # pin the asymmetric table the per-host costs would produce in a
+        # real fleet (the cost math itself is pinned by
+        # test_weighted_router_update_from_scrape_costs_queue_and_p99).
+        router.update_from_scrape(scrape_cluster(registry.address,
+                                                 window=30.0))
+        router.set_weights({fast_addr: 100, slow_addr: 4})
+        res2 = measure_quiet(one_round,
+                             ok=lambda r: not r.errors and
+                             r.p99_ms < 5000.0)
+        assert not res2.errors, res2.errors[:3]
+        slow_share = shares[slow_addr] / max(res2.n_sent, 1)
+        assert slow_share < even_slow_share / 2, \
+            (slow_share, even_slow_share)
+        assert res2.p99_ms < 5000.0, res2.p99_ms
+    finally:
+        for server, q, _ in fleet:
+            q.stop()
+            server.stop()
+        registry.stop()
+
+
+# ------------------------------------------------ actuators: admission
+def test_burn_aware_admission_sheds_before_queue(control_state):
+    """While the verdict burns, requests past the queue allowance answer
+    503 + Retry-After BEFORE queueing: control.admission.shed rises and
+    the partition queue depth stays bounded; with the burn cleared the
+    same load is served in full."""
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+
+    burning = [False]
+    adm = BurnAwareAdmission(verdict_fn=lambda: {"burning": burning[0]},
+                             refresh_s=0.0, retry_after_s=2.5,
+                             queue_allowance=1)
+    server = ServingServer(port=0, num_partitions=1,
+                           admission=adm).start()
+
+    def slow_transform(bodies):
+        time.sleep(0.01)
+        return [{"y": 1.0} for _ in bodies]
+
+    q = ServingQuery(server, slow_transform, mode="microbatch",
+                     max_batch=4).start()
+    try:
+        host, port = server._httpd.server_address[:2]
+        body = json.dumps({"x": 1.0})
+
+        res = run_load(host, port, body, n_clients=6, per_client=20)
+        assert not res.errors       # not burning: nothing shed
+        assert reliability_metrics.get(tnames.CONTROL_ADMISSION_SHED) == 0
+
+        burning[0] = True
+        res = run_load(host, port, body, n_clients=6, per_client=20,
+                       check=lambda s, p: None)
+        shed = reliability_metrics.get(tnames.CONTROL_ADMISSION_SHED)
+        assert shed > 0
+        assert res.n_by_status.get(503, 0) == shed
+        assert res.n_by_status.get(200, 0) > 0   # shed EXCESS, not all
+        assert res.n_dropped == 0
+        # shed-before-queue: accepted requests only ever saw a queue at
+        # or under the allowance, so the depth gauge stays bounded
+        depth = reliability_metrics.peek_gauge(tnames.SERVING_QUEUE_DEPTH)
+        assert depth is not None and depth <= adm.queue_allowance + 1
+
+        # Retry-After rides the 503: drop the allowance so even an
+        # idle-queue request sheds (sequential requests never stack the
+        # queue past an allowance of 1)
+        adm.queue_allowance = -1
+        req = urllib.request.Request(
+            f"http://{host}:{port}/", data=body.encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10).read()
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "2"
+        adm.queue_allowance = 1
+
+        burning[0] = False
+        res = run_load(host, port, body, n_clients=4, per_client=10)
+        assert not res.errors       # burn over: admission reopens
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_burn_aware_admission_caches_and_fails_open(control_state):
+    calls = [0]
+
+    def verdict():
+        calls[0] += 1
+        return {"burning": True}
+
+    now = [0.0]
+    adm = BurnAwareAdmission(verdict_fn=verdict, refresh_s=10.0,
+                             clock=lambda: now[0])
+    assert adm.should_shed(5) is True
+    assert adm.should_shed(5) is True
+    assert calls[0] == 1            # cached inside refresh_s
+    now[0] = 11.0
+    assert adm.should_shed(5) is True
+    assert calls[0] == 2            # refreshed after the window
+    # under the allowance nothing sheds even while burning
+    assert adm.should_shed(0) is False
+
+    def broken():
+        raise RuntimeError("slo engine down")
+    adm2 = BurnAwareAdmission(verdict_fn=broken, refresh_s=0.0)
+    assert adm2.should_shed(100) is False    # fail open
+
+
+# ------------------------------------------------ actuators: scaler
+def test_fleet_scaler_decide_is_pure_policy():
+    sc = FleetScaler(high=0.75, low=0.15, window=3, min_workers=1,
+                     max_workers=4)
+    assert sc.decide([0.8, 0.9, 0.8], 2) == "spawn"
+    assert sc.decide([0.8, 0.9, 0.8], 4) is None      # at max
+    assert sc.decide([0.1, 0.0, 0.1], 2) == "drain"
+    assert sc.decide([0.1, 0.0, 0.1], 1) is None      # at min
+    assert sc.decide([0.8, 0.5, 0.8], 2) is None      # not sustained
+    assert sc.decide([0.9, 0.9], 2) is None           # window not full
+
+
+def test_fleet_scaler_observe_debounces_and_fires_hooks(control_state):
+    fired = []
+    sc = FleetScaler(spawn=lambda: fired.append("spawn"),
+                     drain=lambda: fired.append("drain"),
+                     high=0.75, low=0.15, window=2, cooldown=2,
+                     min_workers=1, max_workers=4)
+    assert sc.observe(0.9, 2) is None        # window not yet full
+    assert sc.observe(0.9, 2) == "spawn"
+    assert fired == ["spawn"]
+    # cooldown: two hot samples land inside the debounce, no action —
+    # but they still fill the window, so the first post-cooldown round
+    # acts immediately on the sustained-hot evidence
+    assert sc.observe(0.9, 3) is None
+    assert sc.observe(0.9, 3) is None
+    assert sc.observe(0.9, 3) == "spawn"
+    assert reliability_metrics.get(tnames.CONTROL_SCALER_SPAWNS) == 2
+    sc2 = FleetScaler(window=1, cooldown=0)
+    assert sc2.observe(0.0, 2) == "drain"
+    assert reliability_metrics.get(tnames.CONTROL_SCALER_DRAINS) == 1
+
+
+# ------------------------------------------------ registry TTL
+def test_registry_ttl_evicts_stale_entries(control_state):
+    from mmlspark_tpu.io.registry import ServiceInfo, ServiceRegistry
+    now = [0.0]
+    reg = ServiceRegistry(ttl_s=5.0, clock=lambda: now[0])
+    a = ServiceInfo(name="serving", host="h1", port=1, process_id=0,
+                    num_partitions=1)
+    b = ServiceInfo(name="serving", host="h2", port=2, process_id=1,
+                    num_partitions=1)
+    reg._put(a)
+    now[0] = 3.0
+    reg._put(b)
+    assert len(reg.services()) == 2
+    now[0] = 6.0                     # a is 6s stale, b only 3s
+    assert [i.host for i in reg.services()] == ["h2"]
+    assert reliability_metrics.get(tnames.REGISTRY_EVICTIONS) == 1
+    # re-registration IS the heartbeat: b refreshed stays alive forever
+    now[0] = 8.0
+    reg._put(b)
+    now[0] = 12.0
+    assert [i.host for i in reg.services()] == ["h2"]
+    assert reliability_metrics.get(tnames.REGISTRY_EVICTIONS) == 1
+    # unregister drops the heartbeat stamp too
+    reg._remove("serving", "h2", 2)
+    assert reg.services() == [] and not reg._last_seen
+
+
+def test_registry_ttl_wire_compat(control_state):
+    """A TTL-armed registry still parses the legacy registration body
+    (no kind, no version, no TTL fields on the wire)."""
+    from mmlspark_tpu.io.registry import ServiceRegistry
+    reg = ServiceRegistry(ttl_s=60.0).start()
+    try:
+        legacy = {"name": "serving", "host": "127.0.0.1", "port": 8080,
+                  "process_id": 0, "num_partitions": 2}
+        req = urllib.request.Request(
+            reg.address + "/register", data=json.dumps(legacy).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+        infos = reg.services("serving")
+        assert len(infos) == 1
+        assert infos[0].kind == "serving" and infos[0].version is None
+        # the /services reply itself is readable by a TTL-less client
+        with urllib.request.urlopen(reg.address + "/services",
+                                    timeout=10) as resp:
+            listed = json.loads(resp.read())
+        assert listed[0]["port"] == 8080
+    finally:
+        reg.stop()
+
+
+# ------------------------------------------------ loadgen
+def test_loadgen_survives_errors_and_counts_statuses(control_state):
+    """A client never aborts: non-2xx responses are tallied per status
+    and failed checks recorded while the loop keeps going; transport
+    failures reconnect and count as dropped."""
+    statuses = iter([200, 500, 200, 503, 200, 200] * 100)
+
+    def post(body):
+        s = next(statuses)
+        if s == 500:
+            raise ConnectionError("socket died")
+        return s, b"{}"
+
+    from mmlspark_tpu.io.loadgen import run_load
+    res = run_load("", 0, "{}", n_clients=1, per_client=30, post=post,
+                   check=lambda s, p: None)
+    assert res.n_sent == 30
+    assert res.n_dropped == 5                  # the raised transports
+    assert len(res.errors) == 5
+    assert res.n_by_status[200] == 20 and res.n_by_status[503] == 5
+    assert res.n_answered == 25
+
+
+def test_loadgen_default_check_records_and_continues(control_state):
+    seq = iter([200, 502, 200] * 10)
+    from mmlspark_tpu.io.loadgen import run_load
+    res = run_load("", 0, "{}", n_clients=1, per_client=30,
+                   post=lambda b: (next(seq), b"{}"))
+    assert res.n_sent == 30 and res.n_dropped == 0
+    assert res.n_by_status[502] == 10
+    assert len(res.errors) == 10               # failed default check
+    assert res.n_ok == 20                      # latency set: passing only
+
+
+# ------------------------------------------------ closed loop (tentpole)
+def _start_fleet(model, n_workers):
+    from mmlspark_tpu.io.registry import (ServiceRegistry,
+                                          report_server_to_registry)
+    from mmlspark_tpu.io.serving import serve_pipeline
+    registry = ServiceRegistry(ttl_s=60.0).start()
+    fleet = []
+    for i in range(n_workers):
+        server, q = serve_pipeline(model, input_cols=["features"],
+                                   mode="microbatch", max_batch=64)
+        host, port = server._httpd.server_address[:2]
+        report_server_to_registry(registry.address, "serving", host, port,
+                                  process_id=i,
+                                  version=q.transform_fn.version)
+        fleet.append((server, q))
+    return registry, fleet
+
+
+def _stop_fleet(registry, fleet):
+    for server, q in fleet:
+        q.stop()
+        server.stop()
+    registry.stop()
+
+
+def test_fleet_poison_candidate_rolls_back_zero_dropped(control_state,
+                                                        tmp_path):
+    """THE tentpole acceptance: a poison candidate deployed mid-load on
+    a live 2-worker fleet burns the error budget, the driver auto-rolls
+    back, the fleet `/slo` verdict returns to ok, ZERO requests are
+    dropped, and the ledger pins deploy < burn < rollback < recovered."""
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.telemetry.exposition import scrape_cluster
+
+    # short windows so burn AND recovery land inside the test
+    tslo.configure(objectives=[tslo.Objective(
+        name="serving.error_rate", kind=tslo.ERROR_RATE,
+        metric=tnames.SERVING_REQUEST_ERRORS,
+        total_metric=tnames.SERVING_REQUEST_TOTAL,
+        budget=0.05, window_s=1.0)], long_factor=2.0)
+    ledger = tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+    inc = _fit(0)
+    body = json.dumps({"features": [0.1] * 5})
+    registry, fleet = _start_fleet(inc, 2)
+    try:
+        router = WeightedRouter(registry.address, "serving")
+        driver = RolloutDriver(
+            workers={f"w{i}": q.transform_fn
+                     for i, (_, q) in enumerate(fleet)},
+            incumbent=inc, candidate=_PoisonModel(),
+            registry_address=registry.address, ledger=ledger,
+            config=RolloutConfig(traffic_steps=(0.5, 1.0), step_polls=3,
+                                 poll_interval_s=0.15,
+                                 scrape_window_s=10.0, recover_polls=80))
+
+        chunks = []
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                chunks.append(run_load(
+                    "", 0, body, n_clients=3, per_client=40,
+                    check=lambda s, p: None,
+                    post=lambda b: router.post(b.encode())))
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            status = driver.run()    # blocks through burn -> recovery
+        finally:
+            stop.set()
+            t.join(timeout=60)
+
+        assert status["state"] == ctl.ROLLED_BACK, status
+        assert status["candidate_on"] == []
+        for _, q in fleet:
+            assert q.transform_fn.version == driver.incumbent_version
+
+        # zero dropped requests across the whole chaos window
+        n_sent = sum(c.n_sent for c in chunks)
+        n_dropped = sum(c.n_dropped for c in chunks)
+        by_status = collections.Counter()
+        for c in chunks:
+            by_status.update(c.n_by_status or {})
+        assert n_sent > 0 and n_dropped == 0, (n_sent, n_dropped)
+        assert by_status.get(502, 0) > 0, by_status   # the burn was real
+        assert by_status.get(200, 0) > 0, by_status   # incumbent served
+
+        # fleet verdict recovered
+        snap = scrape_cluster(registry.address, slo=True)
+        assert snap.slo is not None and snap.slo["ok"] \
+            and not snap.slo["burning"]
+
+        # ledger file order pins the sequence
+        events = [r["event"] for r in ledger.records() if "event" in r]
+        order = [tnames.CONTROL_ROLLOUT_DEPLOY_EVENT,
+                 tnames.CONTROL_ROLLOUT_BURN_EVENT,
+                 tnames.CONTROL_ROLLOUT_ROLLBACK_EVENT,
+                 tnames.CONTROL_ROLLOUT_RECOVERED_EVENT]
+        idx = [events.index(e) for e in order]
+        assert idx == sorted(idx), events
+        burn = next(r for r in ledger.records()
+                    if r.get("event") == tnames.CONTROL_ROLLOUT_BURN_EVENT)
+        assert burn["candidate"] == driver.candidate_version
+    finally:
+        _stop_fleet(registry, fleet)
+
+
+def test_fleet_healthy_candidate_auto_promotes(control_state, tmp_path):
+    """The other half of the acceptance: a HEALTHY candidate walks the
+    staged path on the live fleet and auto-promotes."""
+    ledger = tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+    inc, cand = _fit(0), _fit(1)
+    registry, fleet = _start_fleet(inc, 2)
+    try:
+        driver = RolloutDriver(
+            workers={f"w{i}": q.transform_fn
+                     for i, (_, q) in enumerate(fleet)},
+            incumbent=inc, candidate=cand,
+            registry_address=registry.address, ledger=ledger,
+            config=RolloutConfig(traffic_steps=(0.5, 1.0), step_polls=1,
+                                 soak_polls=1, poll_interval_s=0.1))
+        status = driver.run()
+        assert status["state"] == ctl.PROMOTED, status
+        for _, q in fleet:
+            assert q.transform_fn.version == driver.candidate_version
+        events = [r["event"] for r in ledger.records() if "event" in r]
+        assert events.index(tnames.CONTROL_ROLLOUT_DEPLOY_EVENT) \
+            < events.index(tnames.CONTROL_ROLLOUT_PROMOTE_EVENT)
+        assert tnames.CONTROL_ROLLOUT_ROLLBACK_EVENT not in events
+    finally:
+        _stop_fleet(registry, fleet)
+
+
+# ------------------------------------------------ poller actuator hook
+def test_poller_on_sample_feeds_actuators(control_state):
+    """TelemetryPoller(on_sample=...) is the control loop's feed: the
+    hook sees each (sample, snapshot) round, and a hook that raises
+    counts a poll error without killing the series — actuators never
+    take down the sensor."""
+    from mmlspark_tpu.io.registry import (ServiceRegistry,
+                                          report_server_to_registry)
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.telemetry.poller import TelemetryPoller
+
+    inc = _fit(0)
+    registry = ServiceRegistry().start()
+    server, q = serve_pipeline(inc, input_cols=["features"])
+    fed = []
+
+    def hook(sample, snap):
+        fed.append((sample["workers"], len(snap.workers)))
+        if len(fed) == 2:
+            raise RuntimeError("actuator bug")
+
+    try:
+        host, port = server._httpd.server_address[:2]
+        report_server_to_registry(registry.address, "serving", host, port,
+                                  version=q.transform_fn.version)
+        poller = TelemetryPoller(registry.address, interval_s=60.0,
+                                 window_s=10.0, on_sample=hook)
+        poller.poll_once()
+        errs = reliability_metrics.get(tnames.TELEMETRY_POLL_ERRORS)
+        poller.poll_once()     # hook raises: absorbed, counted
+        poller.poll_once()
+        assert fed == [(1, 1)] * 3
+        assert len(poller.samples()) == 3
+        assert reliability_metrics.get(
+            tnames.TELEMETRY_POLL_ERRORS) == errs + 1
+    finally:
+        q.stop()
+        server.stop()
+        registry.stop()
+
+
+# ------------------------------------------------ no compiled hot path
+def test_control_package_imports_without_jax(control_state):
+    """The graftsem assert-none contract: the control plane is host-side
+    policy over the telemetry/serving substrates — importing it must not
+    pull in jax (no compiled hot path to contract)."""
+    code = ("import sys\n"
+            "import mmlspark_tpu.control\n"
+            "sys.exit(1 if 'jax' in sys.modules else 0)\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]

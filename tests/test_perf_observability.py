@@ -643,6 +643,40 @@ def test_benchdiff_gbdt_gates(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_benchdiff_fleet_gates(tmp_path, capsys):
+    """Round-16 fleet gates: the BENCH_MODE=fleet headline synthesizes
+    fleet.rollback_window_p99_ms and fleet.requests_dropped as born
+    lower-is-better — a round that stretched the chaos-window tail or
+    dropped even one request during rollback fails the diff even though
+    fleet req/s improved."""
+    r1 = tmp_path / "BENCH_r01.json"
+    r2 = tmp_path / "BENCH_r02.json"
+
+    def rec(value, p99, dropped):
+        return {"metric": "fleet_req_per_sec", "value": value,
+                "rollback_window_p99_ms": p99,
+                "requests_dropped": dropped}
+
+    _write_round(r1, 1, [rec(900.0, 40.0, 0)])
+    # req/s up, but the rollback-window tail doubled -> gated
+    _write_round(r2, 2, [rec(1100.0, 85.0, 0)])
+    files = [str(r1), str(r2)]
+    assert benchdiff.main(["--threshold", "0.15"] + files) == 1
+    err = capsys.readouterr().err
+    assert "fleet.rollback_window_p99_ms" in err
+
+    # a single dropped request gates (0 -> 1 is an infinite regression)
+    _write_round(r2, 2, [rec(1100.0, 40.0, 1)])
+    assert benchdiff.main(["--threshold", "0.15"] + files) == 1
+    err = capsys.readouterr().err
+    assert "fleet.requests_dropped" in err
+
+    # clean round: faster, same tail, still zero drops
+    _write_round(r2, 2, [rec(1100.0, 38.0, 0)])
+    assert benchdiff.main(["--threshold", "0.15"] + files) == 0
+    capsys.readouterr()
+
+
 def test_benchdiff_gbdt_gates_on_real_rounds():
     """The committed BENCH_r0N.json history must parse and synthesize the
     derived gate records without error (threshold-free informational

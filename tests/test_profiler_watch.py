@@ -46,6 +46,29 @@ from mmlspark_tpu.utils import tracing
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _fresh_executable_registry():
+    """XLA's debug-info manager serializes an hlo_proto for EVERY live
+    compiled executable into each profiler dump: after a few hundred
+    suite tests the cached fits make a 20ms capture write tens of MB of
+    xplane.pb and the /debug/profile handlers blow their client
+    timeouts. Captures here measure THIS module's work, not the suite's
+    leftovers — drop cached executables so dump size stays proportional
+    to what these tests actually run. jax.clear_caches() alone is not
+    enough: the distributed-GBDT AotCaches live in process-global
+    lru_caches and keep their AOT executables alive (and tracked by the
+    debug-info manager) until explicitly dropped."""
+    import gc
+
+    import jax
+
+    from mmlspark_tpu.models.gbdt import distributed as gbdt_distributed
+    gbdt_distributed._compiled_tree_fn.cache_clear()
+    gbdt_distributed._compiled_chunk_fn.cache_clear()
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture(autouse=True)
 def clean_profiler_state():
     """The profiler tier is process-global (session, ledger, compile
