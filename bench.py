@@ -1100,6 +1100,162 @@ def _bench_fleet():
         "router_weights": router.weights}))
 
 
+def _bench_online():
+    """Continuous learning on the serving stream (ISSUE 17 tentpole).
+
+    Three phases, one JSON line:
+
+    - serving A/B: the SAME fitted VW sparse-pair model behind
+      `serve_pipeline`, scored through the compiled sparse fast path
+      (kernel route: (n, k)-bucketed idx/val pairs, zero recompiles)
+      vs the legacy per-row Table route (the pre-PR path for hashed
+      sparse models — dense-style row assembly + uncompiled
+      model.transform). Headline `online_sparse_req_per_sec`,
+      `dense_baseline_req_per_sec` rides along.
+    - online updates/sec: `OnlineLearner.partial_fit` minibatches at
+      the fixed (rows, k) bucket — ONE compiled executable after the
+      warm-up chunk; reported as live examples folded per second.
+    - adaptation latency: the self-healing window — wall seconds from
+      the FIRST request of a seeded 5-sigma covariate shift on the live
+      worker to the refit candidate PROMOTED by the canary gate (drift
+      trip -> LabelFeed refit -> install -> promote), with zero dropped
+      requests. Born lower-is-better for benchdiff gating
+      (`requests_dropped` too: any drop is a regression)."""
+    import jax
+    from mmlspark_tpu.control import (Observation, RolloutConfig,
+                                      RolloutDriver)
+    from mmlspark_tpu.control import rollout as ctl
+    from mmlspark_tpu.core import Table
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.models.vw.estimators import VowpalWabbitClassifier
+    from mmlspark_tpu.models.vw.learner import VWParams
+    from mmlspark_tpu.online import ContinuousLearner, LabelFeed, OnlineConfig
+    from mmlspark_tpu.online import OnlineLearner
+    from mmlspark_tpu.reliability.metrics import reliability_metrics
+    from mmlspark_tpu.telemetry import lineage as tlineage
+    from mmlspark_tpu.telemetry import quality as tquality
+
+    rng = np.random.default_rng(0)
+    n, k, bits = 20_000, 16, 16
+    slots = rng.integers(0, 1 << bits, size=k).astype(np.int32)
+    idx = np.tile(slots, (n, 1))
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    beta = rng.normal(size=k).astype(np.float32)
+    y = (val @ beta > 0).astype(np.float32)
+    incumbent = VowpalWabbitClassifier(
+        features_col="features", label_col="label", num_bits=bits,
+        num_passes=4).fit(
+            Table({"features_idx": idx, "features_val": val, "label": y}))
+    body = json.dumps({"features_idx": slots.tolist(),
+                       "features_val": [0.1] * k})
+
+    def closed_loop(fast_path):
+        reliability_metrics.reset()
+        tquality.reset_monitor()
+        tlineage.reset_version_registry()
+        server, q = serve_pipeline(
+            incumbent, input_cols=["features_idx", "features_val"],
+            mode="microbatch", max_batch=128, fast_path=fast_path)
+        host, port = server._httpd.server_address[:2]
+        try:
+            res = run_load(host, port, body, n_clients=16, per_client=125)
+            assert not res.errors, res.errors[:3]
+        finally:
+            q.stop()
+            server.stop()
+        return res
+
+    res_sparse = closed_loop(fast_path=True)
+    recompiles = reliability_metrics.get("plan.recompiles")
+    res_dense = closed_loop(fast_path=False)
+
+    # -- online updates/sec at the one compiled bucket -------------------
+    lrn = OnlineLearner(VWParams(loss_function="logistic", num_bits=bits),
+                        warm_start=incumbent, rows=256, k=k)
+    lrn.partial_fit(idx[:256], val[:256], y[:256])      # warm-up compile
+    chunks, t0 = 64, time.perf_counter()
+    for c in range(chunks):
+        lo = (c * 256) % (n - 256)
+        lrn.partial_fit(idx[lo:lo + 256], val[lo:lo + 256],
+                        y[lo:lo + 256])
+    upd_wall = time.perf_counter() - t0
+    updates_per_sec = chunks * 256 / upd_wall
+
+    # -- shift-to-promoted adaptation latency ----------------------------
+    reliability_metrics.reset()
+    tquality.reset_monitor()
+    tlineage.reset_version_registry()
+    shift = (5.0 * beta / np.linalg.norm(beta)).astype(np.float32)
+    server, q = serve_pipeline(
+        incumbent, input_cols=["features_idx", "features_val"],
+        mode="continuous")
+    statuses = []
+    try:
+        mon = tquality.get_monitor()
+        mon.configure(sample=1.0, min_live=24)
+        feed = LabelFeed(evaluator=mon.evaluator)
+        lrn2 = OnlineLearner(VWParams(loss_function="logistic",
+                                      num_bits=bits),
+                             warm_start=incumbent, rows=64, k=k)
+
+        import urllib.request as _rq
+
+        def post(row_idx, row_val, label):
+            data = json.dumps({
+                "features_idx": row_idx.tolist(),
+                "features_val": row_val.tolist()}).encode()
+            req = _rq.Request(server.address, data=data,
+                              headers={"Content-Type": "application/json"})
+            resp = _rq.urlopen(req, timeout=15)
+            resp.read()
+            statuses.append(resp.status)
+            rid = resp.headers["X-Request-Id"]
+            feed.record_features([rid], row_idx[None, :], row_val[None, :])
+            tquality.record_label(rid, float(label))
+
+        def deploy(candidate):
+            sched = iter([Observation()] * 10)
+            drv = RolloutDriver(
+                {"w0": q.transform_fn}, incumbent, lambda: candidate,
+                observe=lambda: next(sched),
+                config=RolloutConfig(traffic_steps=(1.0,), step_polls=1,
+                                     soak_polls=1, poll_interval_s=0.0),
+                sleep=lambda s: None)
+            return drv.run()["state"] == ctl.PROMOTED
+
+        loop = ContinuousLearner(
+            lrn2, feed, deploy=deploy,
+            config=OnlineConfig(min_pairs=32, max_drift=0.5,
+                                poll_interval_s=0.0),
+            sleep=lambda s: None)
+        shifted = val + shift
+        y_shift = (shifted @ beta > 0).astype(np.float32)
+        t0 = time.perf_counter()
+        for i in range(72):
+            post(idx[i], shifted[i], y_shift[i])
+        status = loop.run_once()
+        adapt_latency = time.perf_counter() - t0
+    finally:
+        q.stop()
+        server.stop()
+    assert status.get("outcome") == "promoted", status
+    dropped = sum(1 for s in statuses if s != 200)
+
+    print(json.dumps({
+        "metric": "online_sparse_req_per_sec",
+        "value": round(res_sparse.req_per_sec, 1), "unit": "req/s",
+        "vs_baseline": round(
+            res_sparse.req_per_sec / max(res_dense.req_per_sec, 1e-9), 2),
+        "backend": jax.default_backend(),
+        "dense_baseline_req_per_sec": round(res_dense.req_per_sec, 1),
+        "sparse_p99_ms": round(res_sparse.p99_ms, 2),
+        "plan_recompiles": recompiles,
+        "online_updates_per_sec": round(updates_per_sec, 1),
+        "adapt_latency_s": round(adapt_latency, 3),
+        "requests_dropped": dropped}))
+
+
 def _bench_ckpt():
     """Checkpoint stall per training step, sync vs async (ISSUE 4
     tooling satellite): the SAME LM stream-training loop runs (a) with no
@@ -1581,6 +1737,8 @@ def main():
         return _bench_quality()
     if mode == "fleet":
         return _bench_fleet()
+    if mode == "online":
+        return _bench_online()
     if mode == "hist":
         return _bench_hist()
     # predict/shap modes never print the bandwidth fields — don't spend the

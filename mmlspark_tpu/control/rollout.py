@@ -174,7 +174,12 @@ class RolloutDriver:
     defaults to the configured run ledger (may be None: events still
     emit, journaling is skipped). `faults` arms the `control.rollout.poll`
     chaos site; the `serving.swap` site fires inside each transform's own
-    injector during (re-)installs."""
+    injector during (re-)installs.
+
+    `candidate` may be a model or a zero-arg callable producing one
+    (candidate-source hook): the callable is resolved at construction so
+    the driver's content-addressed `candidate_version` names the exact
+    artifact the rollout ships."""
 
     def __init__(self, workers, incumbent, candidate,
                  registry_address: Optional[str] = None,
@@ -194,6 +199,12 @@ class RolloutDriver:
         self.config = self.machine.config
         self.registry_address = registry_address
         self.incumbent = incumbent
+        # candidate-source hook: a zero-arg callable is resolved here,
+        # once — so continuous-learning producers (online.loop) can hand
+        # the driver a "build my freshest candidate" thunk and the
+        # content-addressed version below names what actually ships
+        if callable(candidate) and not hasattr(candidate, "transform"):
+            candidate = candidate()
         self.candidate = candidate
         self._observe_fn = observe
         self.scrape_timeout = scrape_timeout

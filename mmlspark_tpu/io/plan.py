@@ -189,10 +189,15 @@ class ServingTransform:
     def __init__(self, model, input_cols: Sequence[str],
                  output_col: str = "prediction", max_bucket: int = 4096,
                  metrics=None, max_plans: int = 64, faults=None,
-                 version_content: bool = True):
+                 version_content: bool = True, max_k_bucket: int = 1024):
         self.input_cols = list(input_cols)
         self.output_col = output_col
         self.max_bucket = max_bucket
+        # sparse-pair rows bucket their pairs-per-row (k) the same way
+        # rows bucket: power of two, bounded — ragged rows pad with the
+        # zero-contribution pair so every (rows, k) bucket is one
+        # compiled executable
+        self.max_k_bucket = max(int(max_k_bucket), 1)
         self._metrics = metrics if metrics is not None else reliability_metrics
         self._faults = faults
         self._version_content = version_content
@@ -242,12 +247,23 @@ class ServingTransform:
                   if isinstance(model, PipelineModel) else None)
         model = stages[0] if stages is not None and len(stages) == 1 \
             else model
-        # the row kernel consumes ONE features matrix; multi-column inputs
-        # go through the generic Table path
+        # the row kernel consumes ONE features matrix — or, for sparse
+        # models, the hashed `<f>_idx`/`<f>_val` column PAIR (the kernel
+        # says so with a `sparse_pairs` marker); anything else goes
+        # through the generic Table path
         kernel_of = getattr(model, "_serving_kernel", None)
-        kernel = (kernel_of(self.output_col)
-                  if kernel_of is not None and len(self.input_cols) == 1
-                  else None)
+        kernel = None
+        if kernel_of is not None:
+            if len(self.input_cols) == 1:
+                kernel = kernel_of(self.output_col)
+                if getattr(kernel, "sparse_pairs", False):
+                    kernel = None   # pair kernel needs both columns
+            elif (len(self.input_cols) == 2
+                    and self.input_cols[0].endswith("_idx")
+                    and self.input_cols[1].endswith("_val")):
+                built = kernel_of(self.output_col)
+                if getattr(built, "sparse_pairs", False):
+                    kernel = built
         from ..telemetry import lineage as tlineage
         mv = tlineage.model_version(model, content=self._version_content)
         return _ModelHandle(model, kernel, mv)
@@ -325,6 +341,52 @@ class ServingTransform:
     # replay/502 machinery, never misreported as the client's fault.
     def _build_plan(self, bucket: int, handle: _ModelHandle):
         cols = self.input_cols
+        if handle.kernel is not None and getattr(handle.kernel,
+                                                 "sparse_pairs", False):
+            # sparse hashed-pair fast path: ragged per-row (idx, val)
+            # lists bucket on BOTH axes — rows to `bucket`, pairs-per-
+            # row to a power-of-two k — then hit the compiled kernel.
+            # Padded pairs are (idx 0, val 0): zero score contribution,
+            # same margin as the ragged row. One executable per
+            # (rows, k) bucket lives in jit's cache, so repeated
+            # same-bucket batches keep `plan.recompiles` at 0.
+            kernel = handle.kernel
+            icol, vcol = cols
+            max_k = self.max_k_bucket
+
+            def assemble(rows: list) -> dict:
+                n = len(rows)
+                widest = 1
+                pairs = []
+                for r in rows:
+                    iv, vv = np.asarray(r[icol]), np.asarray(r[vcol])
+                    if (iv.ndim != 1 or iv.shape != vv.shape
+                            or iv.dtype == object or vv.dtype == object):
+                        raise ValueError(
+                            f"columns {icol!r}/{vcol!r} must be matching "
+                            f"1-d (idx, val) pair lists")
+                    if iv.shape[0] > max_k:
+                        raise ValueError(
+                            f"row carries {iv.shape[0]} pairs; the "
+                            f"serving k bucket is bounded at {max_k}")
+                    pairs.append((iv, vv))
+                    widest = max(widest, iv.shape[0])
+                kb = shape_bucket(widest, max_k)
+                idx = np.zeros((n, kb), np.int32)
+                val = np.zeros((n, kb), np.float32)
+                for i, (iv, vv) in enumerate(pairs):
+                    idx[i, :iv.shape[0]] = iv
+                    val[i, :vv.shape[0]] = vv
+                return {icol: idx, vcol: val}
+
+            def run(data: dict) -> np.ndarray:
+                idx, val = data[icol], data[vcol]
+                n = idx.shape[0]
+                idx = pad_rows_to_bucket(idx, bucket)
+                val = pad_rows_to_bucket(val, bucket)
+                return np.asarray(kernel(idx, val))[:n]
+
+            return assemble, run
         if handle.kernel is not None:
             kernel = handle.kernel
             col = cols[0]
@@ -430,7 +492,11 @@ class ServingTransform:
                 handle.fingerprint, bucket, build_s,
                 analysis={"rows_bucket": bucket,
                           "input_cols": len(self.input_cols),
-                          "kind": ("host-kernel" if handle.kernel is not None
+                          "kind": ("sparse-kernel"
+                                   if getattr(handle.kernel, "sparse_pairs",
+                                              False)
+                                   else "host-kernel"
+                                   if handle.kernel is not None
                                    else "table-transform")},
                 label=type(handle.model).__name__,
                 registry=(None if self._metrics is reliability_metrics

@@ -95,6 +95,81 @@ class _VWModelBase(Model, HasFeaturesCol, HasPredictionCol):
     def _features(self, t: Table):
         return _VWParamsMixin._features(self, t)
 
+    def _sparse_link(self) -> Optional[str]:
+        """Link applied by the serving kernel; overridden per family."""
+        return None
+
+    def _serving_kernel(self, output_col: str):
+        """Compiled sparse-pair scorer for the serving fast path.
+
+        Marked `sparse_pairs=True`: `ServingTransform` recognizes the
+        marker when its input_cols are the `<f>_idx`/`<f>_val` pair and
+        feeds (rows, k)-bucketed int32/float32 arrays straight to the
+        jitted kernel — the first non-dense workload on the hot path.
+        One executable per (rows, k) bucket lives in jit's cache, so
+        repeated same-bucket batches never recompile."""
+        del output_col
+        import jax.numpy as jnp
+
+        from .learner import _predict_sparse
+        weights = jnp.asarray(np.asarray(self._weights, np.float32))
+        bias = np.float32(self._bias)
+        link = self._sparse_link()
+
+        def kernel(idx, val):
+            score = np.asarray(_predict_sparse(weights, bias, idx, val,
+                                               link=link))
+            if link == "logistic":
+                # match _transform's prediction column: the class id
+                return (score > 0.5).astype(np.float64)
+            return score.astype(np.float64)
+
+        kernel.sparse_pairs = True
+        return kernel
+
+
+def _attach_observability(est, model, idx, val) -> None:
+    """Quality + lineage stamps on a fresh VW fit, mirroring the GBDT
+    estimators: a drift reference over the PREDICTION column (hashed
+    idx/val matrices have no stable per-column identity to profile) and
+    a lineage record journaled to the run ledger. Never fails a fit."""
+    try:
+        import hashlib
+        import json
+
+        from ...telemetry import lineage as tlineage
+        from ...telemetry.quality import DatasetProfile
+        head = slice(0, 8192)
+        pred_t = model.transform(
+            Table({f"{model.features_col}_idx": idx[head],
+                   f"{model.features_col}_val": val[head]}))
+        pred = np.asarray(pred_t[model.prediction_col], np.float64)
+        model.quality_profile = DatasetProfile.fit(
+            {"prediction": pred}).state()
+        params = {}
+        for pname, p in type(est).params().items():
+            if p.transient:
+                continue
+            v = est.get_or_default(pname)
+            try:
+                json.dumps(v)
+                params[pname] = v
+            except (TypeError, ValueError):
+                params[pname] = repr(v)
+        lineage = {"estimator": type(est).__name__, "uid": est.uid,
+                   "params": params}
+        canon = json.dumps(model.quality_profile, sort_keys=True,
+                           default=str)
+        lineage["reference_profile"] = hashlib.sha256(
+            canon.encode()).hexdigest()[:12]
+        model.lineage = lineage
+        ledger = tlineage.get_run_ledger()
+        if ledger is not None:
+            ledger.append(
+                tlineage.model_version(model, content=True).export())
+    except Exception:  # noqa: BLE001 - observability never fails a fit
+        pass
+
 
 class VowpalWabbitRegressor(Estimator, _VWParamsMixin):
     def _fit(self, t: Table) -> "VowpalWabbitRegressionModel":
@@ -106,10 +181,12 @@ class VowpalWabbitRegressor(Estimator, _VWParamsMixin):
                                       weights=w,
                                       initial_model=self.initial_model,
                                       num_tasks=self.num_tasks)
-        return VowpalWabbitRegressionModel(
+        model = VowpalWabbitRegressionModel(
             weights=weights, bias=bias, stats=stats,
             features_col=self.features_col, prediction_col=self.prediction_col,
             num_bits=self.num_bits)
+        _attach_observability(self, model, idx, val)
+        return model
 
 
 class VowpalWabbitRegressionModel(_VWModelBase):
@@ -131,13 +208,18 @@ class VowpalWabbitClassifier(Estimator, _VWParamsMixin, HasProbabilitiesCol):
                                       weights=w,
                                       initial_model=self.initial_model,
                                       num_tasks=self.num_tasks)
-        return VowpalWabbitClassificationModel(
+        model = VowpalWabbitClassificationModel(
             weights=weights, bias=bias, stats=stats,
             features_col=self.features_col, prediction_col=self.prediction_col,
             probabilities_col=self.probabilities_col, num_bits=self.num_bits)
+        _attach_observability(self, model, idx, val)
+        return model
 
 
 class VowpalWabbitClassificationModel(_VWModelBase, HasProbabilitiesCol):
+    def _sparse_link(self) -> Optional[str]:
+        return "logistic"
+
     def _transform(self, t: Table) -> Table:
         idx, val = self._features(t)
         p1 = predict_vw(self._weights, self._bias, idx, val, link="logistic")
@@ -227,6 +309,10 @@ class VowpalWabbitContextualBandit(Estimator, _VWParamsMixin):
 
 class VowpalWabbitContextualBanditModel(_VWModelBase):
     num_actions = Param("num_actions", "action count", 2)
+
+    # action-crossed scoring doesn't fit the single-margin kernel; the
+    # Table path serves bandit models
+    _serving_kernel = None
 
     def _transform(self, t: Table) -> Table:
         idx, val = self._features(t)

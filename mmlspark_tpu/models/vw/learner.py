@@ -68,6 +68,19 @@ def _predict_margin(weights, bias, idx, val):
     return jnp.sum(weights[idx] * val, axis=-1) + bias
 
 
+@functools.partial(jax.jit, static_argnames=("link",))
+def _predict_sparse(weights, bias, idx, val, link=None):
+    """Compiled sparse-pair scoring — the serving fast path's kernel.
+
+    Shape-bucketed by the caller (ServingTransform pads rows and pairs
+    to power-of-two buckets), so jit's cache holds one executable per
+    (rows, k) bucket and `plan.recompiles` stays 0."""
+    m = _predict_margin(weights, bias, idx, val)
+    if link == "logistic":
+        m = jax.nn.sigmoid(m)
+    return m
+
+
 def _loss_grad(margin, y, w, loss_function: str):
     if loss_function == "logistic":
         # y in {0,1}; VW reports logistic loss

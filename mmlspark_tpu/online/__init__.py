@@ -1,0 +1,21 @@
+"""Continuous learning on the serving stream (docs/online.md).
+
+The missing middle of the closed loop: `StreamingEvaluator` joins
+delayed labels to served predictions, `install_model` hot-swaps with
+zero dropped requests, `RolloutDriver` canary-gates — this package
+feeds the joined pairs back into training and ships the result.
+
+- `learner`:  `OnlineLearner` — incremental VW updates on a fixed
+  (rows, k) shape bucket, one compiled executable for life.
+- `stream`:   `LabelFeed` — bounded minibatch buffer on evaluator joins.
+- `loop`:     `ContinuousLearner` — drift-trip/floor-burn → refit →
+  canary gate → promote or rollback, every transition journaled.
+"""
+from .learner import OnlineLearner
+from .stream import LabelFeed
+from .loop import (ContinuousLearner, ContinuousLearnerMachine,
+                   OnlineAction, OnlineConfig, OnlineObservation)
+
+__all__ = ["OnlineLearner", "LabelFeed", "ContinuousLearner",
+           "ContinuousLearnerMachine", "OnlineAction", "OnlineConfig",
+           "OnlineObservation"]

@@ -1,0 +1,276 @@
+"""Incremental VW learner on a fixed shape bucket (docs/online.md).
+
+`OnlineLearner` carries the mutable training state the batch
+`fit_vw` path deliberately hides: hashed weights, bias, and the
+AdaGrad accumulator, updated one minibatch at a time. Every
+`partial_fit` pads its rows to ONE canonical (rows, k) shape bucket,
+so every update in the process's lifetime — warm-start, steady
+stream, post-refit — hits the same compiled executable
+(`online_update_contract` pins this; recompiles on the update path
+are a bug, not a cost).
+
+Padding follows the batch learner's convention exactly: padded pairs
+carry `val == 0` (zero gradient contribution) and padded rows carry
+`w == 0` (zero loss weight), so a padded minibatch computes the same
+update as the ragged one.
+
+Each `make_model()` is a content-addressed candidate: a normal
+`VowpalWabbit*Model` stamped with online lineage, its `ModelVersion`
+journaled to the run ledger when one is configured — the same record
+shape batch fits stamp, so the deployment trail reads uniformly.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.vw.learner import (VWParams, _loss_grad, _predict_margin,
+                                 _predict_sparse)
+from ..reliability.metrics import reliability_metrics
+from ..stages.batching import shape_bucket
+from ..telemetry import names as tnames
+
+
+@functools.partial(jax.jit, static_argnames=("loss_function",))
+def _online_update(idx, val, y, w, weights, bias, acc, lr, l2,
+                   loss_function="logistic"):
+    """One AdaGrad minibatch update at a fixed (rows, k) shape.
+
+    Mirrors `_fit_sgd`'s inner step but takes the accumulator as
+    carried state instead of zero-initializing it — that is what makes
+    the update *incremental* across refits."""
+    dim = weights.shape[0]
+    margin = _predict_margin(weights, bias, idx, val)
+    gm, loss = _loss_grad(margin, y, w, loss_function)
+    flat_idx = (idx & (dim - 1)).reshape(-1)
+    flat_g = (gm[:, None] * val).reshape(-1)
+    gw = jax.ops.segment_sum(flat_g, flat_idx, num_segments=dim)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    gw = gw / denom + l2 * weights
+    gb = jnp.sum(gm) / denom
+    acc = acc + gw * gw
+    weights = weights - lr * gw / jnp.sqrt(acc + 1e-8)
+    bias = bias - lr * gb
+    return weights, bias, acc, jnp.sum(loss) / denom
+
+
+
+
+class OnlineLearner:
+    """Incremental VW training state with snapshot/rewind.
+
+    Parameters
+    ----------
+    params:      `VWParams` — `loss_function` picks the model family
+                 (`logistic` -> classifier, `squared` -> regressor);
+                 `learning_rate`/`l2`/`num_bits` apply per minibatch.
+                 The online path is always adaptive (AdaGrad): that is
+                 the mode whose accumulator makes warm-started
+                 incremental updates well-behaved.
+    warm_start:  incumbent `VowpalWabbit*Model` (or `(weights, bias)`)
+                 whose weights seed the learner. The AdaGrad
+                 accumulator starts at zero and is carried across every
+                 subsequent refit.
+    rows:        the fixed row bucket every minibatch is padded to.
+    k:           the fixed pairs-per-row bucket; inferred (power of
+                 two) from the first minibatch when None, frozen after.
+    """
+
+    MAX_K = 1024
+
+    def __init__(self, params: Optional[VWParams] = None, *,
+                 warm_start=None, rows: int = 256, k: Optional[int] = None,
+                 metrics=None):
+        self.params = params or VWParams(loss_function="logistic")
+        self.rows = max(int(rows), 1)
+        self._k = None if k is None else shape_bucket(int(k), self.MAX_K)
+        self._metrics = metrics if metrics is not None \
+            else reliability_metrics
+        dim = 1 << self.params.num_bits
+        weights, bias = np.zeros(dim, np.float32), 0.0
+        if warm_start is not None:
+            if hasattr(warm_start, "_weights"):
+                weights = np.asarray(warm_start._weights, np.float32)
+                bias = float(warm_start._bias)
+            else:
+                weights, bias = warm_start
+                weights = np.asarray(weights, np.float32)
+                bias = float(bias)
+            if weights.shape[0] != dim:
+                raise ValueError(
+                    f"warm-start weights have {weights.shape[0]} slots, "
+                    f"params.num_bits={self.params.num_bits} needs {dim}")
+        self._weights = weights.copy()
+        self._bias = np.float32(bias)
+        self._acc = np.zeros(dim, np.float32)
+        self.updates = 0        # compiled minibatch executions
+        self.examples = 0       # live (unpadded) rows consumed
+        self.refits = 0         # make_model() candidates produced
+        self.last_loss: Optional[float] = None
+
+    # -- shape discipline -----------------------------------------------------
+    def _bucket(self, idx: np.ndarray, val: np.ndarray):
+        """Freeze k on first contact, then pad pairs out to it. Padded
+        pairs use idx 0 / val 0 — zero gradient, zero score."""
+        if self._k is None:
+            self._k = shape_bucket(max(idx.shape[1], 1), self.MAX_K)
+        if idx.shape[1] > self._k:
+            raise ValueError(
+                f"minibatch has {idx.shape[1]} pairs/row; this learner's "
+                f"frozen k bucket is {self._k}")
+        pad = self._k - idx.shape[1]
+        if pad:
+            idx = np.pad(idx, ((0, 0), (0, pad)))
+            val = np.pad(val, ((0, 0), (0, pad)))
+        return idx, val
+
+    @property
+    def k(self) -> Optional[int]:
+        return self._k
+
+    # -- the update -----------------------------------------------------------
+    def partial_fit(self, idx, val, y, w=None) -> dict:
+        """Fold a ragged minibatch of hashed sparse pairs into the
+        learner. Rows are chunked and padded to the fixed (rows, k)
+        bucket; every chunk is one execution of the ONE compiled
+        update."""
+        idx = np.asarray(idx, np.int32)
+        val = np.asarray(val, np.float32)
+        y = np.asarray(y, np.float32).reshape(-1)
+        if idx.ndim != 2 or idx.shape != val.shape:
+            raise ValueError("idx/val must be matching (n, k) arrays")
+        if idx.shape[0] != y.shape[0]:
+            raise ValueError("idx/val and y row counts differ")
+        w = (np.ones_like(y) if w is None
+             else np.asarray(w, np.float32).reshape(-1))
+        idx, val = self._bucket(idx, val)
+        lr = np.float32(self.params.learning_rate)
+        l2 = np.float32(self.params.l2)
+        total_loss, chunks = 0.0, 0
+        for start in range(0, idx.shape[0], self.rows):
+            ci, cv = idx[start:start + self.rows], val[start:start + self.rows]
+            cy, cw = y[start:start + self.rows], w[start:start + self.rows]
+            live = ci.shape[0]
+            if live < self.rows:
+                pad = ((0, self.rows - live), (0, 0))
+                ci, cv = np.pad(ci, pad), np.pad(cv, pad)
+                cy = np.pad(cy, (0, self.rows - live))
+                cw = np.pad(cw, (0, self.rows - live))   # w=0: no loss
+            weights, bias, acc, loss = _online_update(
+                ci, cv, cy, cw, self._weights, self._bias, self._acc,
+                lr, l2, loss_function=self.params.loss_function)
+            self._weights = np.asarray(weights)
+            self._bias = np.float32(bias)
+            self._acc = np.asarray(acc)
+            total_loss += float(loss)
+            chunks += 1
+            self.updates += 1
+            self.examples += int(live)
+            self._metrics.inc(tnames.ONLINE_LEARNER_UPDATES)
+        self.last_loss = total_loss / max(chunks, 1)
+        return {"updates": chunks, "examples": int(y.shape[0]),
+                "loss": self.last_loss}
+
+    # -- snapshot / rewind ----------------------------------------------------
+    def snapshot(self) -> dict:
+        """Copy-out of everything a failed refit must rewind."""
+        return {"weights": self._weights.copy(),
+                "bias": np.float32(self._bias),
+                "acc": self._acc.copy(),
+                "updates": self.updates, "examples": self.examples,
+                "refits": self.refits, "last_loss": self.last_loss}
+
+    def restore(self, snap: dict) -> None:
+        self._weights = snap["weights"].copy()
+        self._bias = np.float32(snap["bias"])
+        self._acc = snap["acc"].copy()
+        self.updates = snap["updates"]
+        self.examples = snap["examples"]
+        self.refits = snap["refits"]
+        self.last_loss = snap["last_loss"]
+
+    # -- candidate production -------------------------------------------------
+    def make_model(self, features_col: str = "features",
+                   prediction_col: str = "prediction",
+                   reference_profile: Optional[dict] = None,
+                   reason: Optional[str] = None):
+        """Freeze the current state into a content-addressed candidate.
+
+        Returns a plain `VowpalWabbit*Model` (classification for
+        logistic loss, regression for squared) stamped with online
+        lineage; its `ModelVersion` is journaled to the run ledger when
+        one is configured — same record shape as batch-fit stamps."""
+        from ..models.vw.estimators import (VowpalWabbitClassificationModel,
+                                            VowpalWabbitRegressionModel)
+        stats = {"passes": 0, "online_updates": self.updates,
+                 "online_examples": self.examples,
+                 "final_loss": self.last_loss}
+        kw = dict(weights=self._weights.copy(), bias=float(self._bias),
+                  stats=stats, features_col=features_col,
+                  prediction_col=prediction_col,
+                  num_bits=self.params.num_bits)
+        if self.params.loss_function == "logistic":
+            model = VowpalWabbitClassificationModel(**kw)
+        else:
+            model = VowpalWabbitRegressionModel(**kw)
+        self.refits += 1
+        lineage = {"estimator": "OnlineLearner",
+                   "loss_function": self.params.loss_function,
+                   "refit": self.refits, "updates": self.updates,
+                   "examples": self.examples, "loss": self.last_loss}
+        if reason is not None:
+            lineage["reason"] = reason
+        model.lineage = lineage
+        if reference_profile is not None:
+            model.quality_profile = reference_profile
+        from ..telemetry import lineage as tlineage
+        ledger = tlineage.get_run_ledger()
+        if ledger is not None:
+            ledger.append(
+                tlineage.model_version(model, content=True).export())
+        return model
+
+
+# --------------------------------------------------------------- contract
+# PR-13 discipline: the semantic tier proves the claim the docstring
+# makes — warm-start, steady-stream, and post-refit updates at the
+# canonical bucket are ONE executable, with zero collectives (the online
+# path is single-host by design; scale-out happens in batch refits).
+from ..analysis.semantic import Case, hot_path_contract  # noqa: E402
+
+_CONTRACT_ROWS, _CONTRACT_K, _CONTRACT_BITS = 32, 8, 12
+
+
+@hot_path_contract(
+    "online.update",
+    expected_executables=1,
+    donate_expected=(),
+    collective_budget={},
+    shape_buckets={0: (0, (_CONTRACT_ROWS,))},
+)
+def online_update_contract():
+    import numpy as _np
+    dim = 1 << _CONTRACT_BITS
+    rng = _np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, dim, size=(_CONTRACT_ROWS,
+                                                 _CONTRACT_K)), jnp.int32)
+    val = jnp.asarray(rng.normal(size=(_CONTRACT_ROWS, _CONTRACT_K)),
+                      jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=_CONTRACT_ROWS), jnp.float32)
+    w = jnp.ones(_CONTRACT_ROWS, jnp.float32)
+    fn = functools.partial(_online_update, loss_function="logistic")
+    warm = jnp.asarray(rng.normal(size=dim) * 0.01, jnp.float32)
+    zeros = jnp.zeros(dim, jnp.float32)
+    lr, l2, bias = np.float32(0.5), np.float32(0.0), np.float32(0.0)
+    cases = []
+    for name, weights, acc in (("warm-start", warm, zeros),
+                               ("steady", warm, jnp.abs(warm)),
+                               ("post-refit", zeros, zeros)):
+        cases.append(Case(name, fn,
+                          (idx, val, y, w, weights, bias, acc, lr, l2),
+                          group="online.update"))
+    return cases

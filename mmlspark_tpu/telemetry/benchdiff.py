@@ -35,6 +35,15 @@ born ``lower_better`` — a round that stretched the chaos-window tail or
 dropped even one request during rollback fails the diff regardless of
 throughput.
 
+Online-learning gates (round 17): every ``online_sparse_req_per_sec``
+record (BENCH_MODE=online — the sparse-pair serving fast path with the
+continuous-learning loop driven through a seeded covariate shift)
+additionally synthesizes ``online.updates_per_sec`` (higher-is-better:
+the fixed-bucket `partial_fit` throughput) plus ``online.adapt_latency_s``
+and ``online.requests_dropped`` (both born ``lower_better`` — the
+shift-to-promoted window must not stretch, and a drop during the swap is
+a regression even if raw req/s improved).
+
 Backend gating (round 11): records carry a ``backend`` annotation (from
 the record itself, or a round file's top-level ``backend`` declaration —
 bench.py stamps ``jax.default_backend()``); records measured on a
@@ -177,9 +186,38 @@ def _fleet_records(rec: dict) -> list:
     return out
 
 
+# fields of the BENCH_MODE=online headline that gate as first-class
+# metrics: partial_fit throughput (higher better) and the self-healing
+# window + zero-drop acceptance (born lower-is-better)
+_ONLINE_METRIC = "online_sparse_req_per_sec"
+_ONLINE_HIGHER_FIELDS = ("online_updates_per_sec",)
+_ONLINE_LOWER_FIELDS = ("adapt_latency_s", "requests_dropped")
+
+
+def _online_records(rec: dict) -> list:
+    """Derived gate records from one online-bench headline record; the
+    parent's backend annotation rides along."""
+    if rec.get("metric") != _ONLINE_METRIC:
+        return []
+    out = []
+    for field, lower in ([(f, False) for f in _ONLINE_HIGHER_FIELDS]
+                         + [(f, True) for f in _ONLINE_LOWER_FIELDS]):
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            d = {"metric": f"online.{field.removeprefix('online_')}",
+                 "value": float(v)}
+            if lower:
+                d["lower_better"] = True
+            if rec.get("backend") is not None:
+                d["backend"] = rec["backend"]
+            out.append(d)
+    return out
+
+
 def _with_derived(records: list) -> list:
     return records + [d for r in records
-                      for d in _gbdt_records(r) + _fleet_records(r)]
+                      for d in (_gbdt_records(r) + _fleet_records(r)
+                                + _online_records(r))]
 
 
 def _records_from_text(text: str) -> list:

@@ -77,7 +77,16 @@ QUALITY_LABELS_JOINED = "quality.labels.joined"
 QUALITY_LABELS_LATE = "quality.labels.late"
 QUALITY_LABELS_DUP = "quality.labels.dup"
 QUALITY_LABELS_DROPPED = "quality.labels.dropped"
+QUALITY_JOIN_SUBSCRIBER_ERRORS = "quality.join.subscriber_errors"
 QUALITY_SKETCH_ROWS = "quality.sketch.rows"
+ONLINE_FEED_PAIRS = "online.feed.pairs"
+ONLINE_FEED_DROPPED = "online.feed.dropped"
+ONLINE_LEARNER_UPDATES = "online.learner.updates"
+ONLINE_TRIPS = "online.trips"
+ONLINE_REFITS = "online.refits"
+ONLINE_REFIT_RETRIES = "online.refit_retries"
+ONLINE_PROMOTIONS = "online.promotions"
+ONLINE_ROLLBACKS = "online.rollbacks"
 SERVING_MODEL_SWAPS = "serving.model.swaps"
 SERVING_MODEL_SWAP_ERRORS = "serving.model.swap_errors"
 REGISTRY_EVICTIONS = "registry.evictions"
@@ -172,8 +181,30 @@ COUNTERS = {
     QUALITY_LABELS_DROPPED: "labels lost to the join: prediction aged "
                             "out of the bounded window, parked-label "
                             "eviction, or injected label loss",
+    QUALITY_JOIN_SUBSCRIBER_ERRORS: "on_join subscriber callbacks that "
+                                    "raised (absorbed; the join itself "
+                                    "is never undone)",
     QUALITY_SKETCH_ROWS: "served rows folded into the live quality "
                          "sketches (head-sampled by request id)",
+    ONLINE_FEED_PAIRS: "joined (features, label) pairs buffered by the "
+                       "LabelFeed for incremental refits",
+    ONLINE_FEED_DROPPED: "joined pairs the LabelFeed lost: features "
+                         "evicted before the label joined, or the "
+                         "bounded pair buffer overflowed",
+    ONLINE_LEARNER_UPDATES: "compiled minibatch updates applied by the "
+                            "OnlineLearner (one per padded (rows, k) "
+                            "bucket execution)",
+    ONLINE_TRIPS: "continuous-learner triggers (drift trip or quality "
+                  "floor burn) that started a refit cycle",
+    ONLINE_REFITS: "incremental refits that completed and produced a "
+                   "candidate ModelVersion",
+    ONLINE_REFIT_RETRIES: "refit attempts retried under the continuous "
+                          "learner's RetryPolicy (each retry rewinds to "
+                          "the pre-refit snapshot first)",
+    ONLINE_PROMOTIONS: "online candidates promoted by the rollout gate",
+    ONLINE_ROLLBACKS: "online candidates rolled back by the rollout "
+                      "gate (learner state rewound to the pre-refit "
+                      "snapshot)",
     SERVING_MODEL_SWAPS: "install_model hot-swaps committed (the old "
                          "version's plans drain, never invalidate)",
     SERVING_MODEL_SWAP_ERRORS: "install_model swaps that failed and "
@@ -228,6 +259,7 @@ TRAIN_LOST_SECONDS = "train.lost_seconds"
 TRAIN_STRAGGLERS = "train.stragglers"
 TELEMETRY_WATCH_TRIPPED = "telemetry.watch.tripped"
 QUALITY_DRIFT_MAX = "quality.drift.max"
+ONLINE_BUFFER_PAIRS = "online.buffer.pairs"
 SERVING_MODEL_VERSION_INFO = "serving.model.version_info"
 CANARY_P99_RATIO = "canary.p99.ratio"
 CANARY_ERROR_BURN = "canary.error_burn"
@@ -266,6 +298,8 @@ GAUGES = {
     QUALITY_DRIFT_MAX: "worst per-column PSI between the frozen "
                        "reference profile and the live serving sketches "
                        "(the quality SLO's drift-ceiling input)",
+    ONLINE_BUFFER_PAIRS: "joined pairs currently buffered in the "
+                         "LabelFeed (drains on each refit)",
     SERVING_MODEL_VERSION_INFO: "number of model versions currently "
                                 "tracked (incumbent + candidate); the "
                                 "served version ids ride /versions",
@@ -399,6 +433,11 @@ CONTROL_ROLLOUT_BURN_EVENT = "control.rollout.burn"
 CONTROL_ROLLOUT_PROMOTE_EVENT = "control.rollout.promote"
 CONTROL_ROLLOUT_ROLLBACK_EVENT = "control.rollout.rollback"
 CONTROL_ROLLOUT_RECOVERED_EVENT = "control.rollout.recovered"
+ONLINE_TRIP_EVENT = "online.trip"
+ONLINE_REFIT_EVENT = "online.refit"
+ONLINE_DEPLOY_EVENT = "online.deploy"
+ONLINE_PROMOTE_EVENT = "online.promote"
+ONLINE_ROLLBACK_EVENT = "online.rollback"
 
 EVENTS = {
     FAULT_INJECTED_EVENT: "one FaultInjector firing (site, index, kind)",
@@ -433,6 +472,21 @@ EVENTS = {
     CONTROL_ROLLOUT_RECOVERED_EVENT: "post-rollback fleet SLO verdict "
                                      "returned to ok (ok attr False when "
                                      "the wait timed out)",
+    ONLINE_TRIP_EVENT: "continuous learner triggered a refit cycle "
+                       "(reason drift/floor-burn, buffered-pairs attrs) "
+                       "— always journaled before online.refit",
+    ONLINE_REFIT_EVENT: "incremental refit completed: candidate "
+                        "ModelVersion + lineage (version, updates, "
+                        "examples, loss attrs)",
+    ONLINE_DEPLOY_EVENT: "candidate handed to the rollout gate "
+                         "(version attr) — journaled after online.refit, "
+                         "before the rollout's own deploy event",
+    ONLINE_PROMOTE_EVENT: "rollout gate promoted the online candidate "
+                          "(version attr); terminal event of a healthy "
+                          "cycle",
+    ONLINE_ROLLBACK_EVENT: "rollout gate rejected the online candidate — "
+                           "incumbent restored, learner rewound to the "
+                           "pre-refit snapshot (version attr)",
     "registry.{action}": "registry HTTP hops (register/unregister) under "
                          "the caller's propagated trace",
 }
@@ -466,6 +520,11 @@ FAULT_SITES = {
                             "each poll round (kind `error` counts "
                             "control.rollout.poll_errors and skips the "
                             "round; `delay` stretches the poll)",
+    "online.refit": "ContinuousLearner refit, fired after the minibatch "
+                    "updates but before the candidate model is built (a "
+                    "raise rewinds the learner to the pre-refit snapshot "
+                    "and retries — counted online.refit_retries; the "
+                    "incumbent keeps serving throughout)",
 }
 
 

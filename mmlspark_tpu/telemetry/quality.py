@@ -477,14 +477,25 @@ class StreamingEvaluator:
     Chaos: the `quality.label` fault site fires per label when an
     injector is attached — kind ``drop`` loses the label pre-join
     (counted dropped), so seeded schedules replay identical anomaly
-    sequences."""
+    sequences.
+
+    Joined pairs are also PUSHED: `subscribe(fn)` (or `on_join=`)
+    registers a `fn(request_id, prediction, label)` callback fired once
+    per successful join, outside the evaluator lock. Fan-out is bounded
+    (`MAX_SUBSCRIBERS`) and a raising subscriber is counted
+    (`quality.join.subscriber_errors`) and absorbed — a bad consumer
+    can never kill the evaluator or undo the join. This is the label
+    feed an online learner trains from."""
 
     # classification joins outside [0, MAX_CLASSES) are invalid input,
     # not a request to grow the count matrix without bound
     MAX_CLASSES = 256
+    # joined-pair fan-out is bounded like every other buffer here
+    MAX_SUBSCRIBERS = 8
 
     def __init__(self, kind: str = "auto", max_pending: int = 4096,
-                 max_parked: int = 1024, registry=None, faults=None):
+                 max_parked: int = 1024, registry=None, faults=None,
+                 on_join=None):
         if kind not in ("auto", "classification", "regression"):
             raise ValueError(
                 "kind must be auto|classification|regression")
@@ -494,6 +505,9 @@ class StreamingEvaluator:
         self._metrics = registry if registry is not None \
             else reliability_metrics
         self._faults = faults
+        self._subscribers: list = []
+        if on_join is not None:
+            self.subscribe(on_join)
         self._lock = threading.Lock()
         self._resolved: Optional[str] = None if kind == "auto" else kind
         self._pending: OrderedDict = OrderedDict()   # id -> prediction
@@ -503,6 +517,29 @@ class StreamingEvaluator:
         self._cls = None
         self._reg = None
         self._joined_total = 0
+
+    # -- join fan-out ---------------------------------------------------------
+    def subscribe(self, callback):
+        """Register `fn(request_id, prediction, label)`, fired once per
+        successful join. Bounded: past MAX_SUBSCRIBERS is a config
+        error, not a silent drop."""
+        if not callable(callback):
+            raise TypeError("on_join subscriber must be callable")
+        if len(self._subscribers) >= self.MAX_SUBSCRIBERS:
+            raise ValueError(
+                f"subscriber fan-out is bounded at {self.MAX_SUBSCRIBERS}")
+        self._subscribers.append(callback)
+        return callback
+
+    def _notify_join(self, rid: str, pred: float, label: float) -> None:
+        """Fan a joined pair out to subscribers — called with the lock
+        RELEASED (a subscriber may call back into the evaluator). A
+        raising subscriber is counted and absorbed; the join stands."""
+        for fn in list(self._subscribers):
+            try:
+                fn(rid, pred, label)
+            except Exception:
+                self._metrics.inc(tnames.QUALITY_JOIN_SUBSCRIBER_ERRORS)
 
     # -- value plumbing -------------------------------------------------------
     @staticmethod
@@ -580,16 +617,19 @@ class StreamingEvaluator:
                     self._metrics.inc(tnames.QUALITY_LABELS_DROPPED)
                     return "dropped"
                 self._metrics.inc(tnames.QUALITY_LABELS_LATE)
-                return "late-join"
-            if request_id in self._joined:
+            elif request_id in self._joined:
                 return "joined"
-            self._pending[request_id] = v
-            while len(self._pending) > self.max_pending:
-                old, _ = self._pending.popitem(last=False)
-                self._evicted[old] = None
-                while len(self._evicted) > self.max_pending:
-                    self._evicted.popitem(last=False)
-        return "pending"
+            else:
+                self._pending[request_id] = v
+                while len(self._pending) > self.max_pending:
+                    old, _ = self._pending.popitem(last=False)
+                    self._evicted[old] = None
+                    while len(self._evicted) > self.max_pending:
+                        self._evicted.popitem(last=False)
+                return "pending"
+        # late join succeeded: fan out with the lock released
+        self._notify_join(request_id, v, label)
+        return "late-join"
 
     def record_label(self, request_id: str, label) -> str:
         if self._faults is not None:
@@ -616,19 +656,23 @@ class StreamingEvaluator:
                     # counted, never crashed — the contract
                     self._metrics.inc(tnames.QUALITY_LABELS_DROPPED)
                     return "dropped"
-                return "joined"
-            if request_id in self._evicted:
+                pass
+            elif request_id in self._evicted:
                 # label-after-eviction: the prediction aged out of the
                 # bounded window before its label arrived
                 self._evicted.pop(request_id, None)
                 self._metrics.inc(tnames.QUALITY_LABELS_DROPPED)
                 return "dropped"
-            # label BEFORE prediction: park it for the late join
-            self._parked[request_id] = y
-            while len(self._parked) > self.max_parked:
-                self._parked.popitem(last=False)
-                self._metrics.inc(tnames.QUALITY_LABELS_DROPPED)
-        return "parked"
+            else:
+                # label BEFORE prediction: park it for the late join
+                self._parked[request_id] = y
+                while len(self._parked) > self.max_parked:
+                    self._parked.popitem(last=False)
+                    self._metrics.inc(tnames.QUALITY_LABELS_DROPPED)
+                return "parked"
+        # joined inside the lock: fan out with it released
+        self._notify_join(request_id, pred, y)
+        return "joined"
 
     # -- read side ------------------------------------------------------------
     def metrics(self) -> dict:

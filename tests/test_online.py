@@ -1,0 +1,510 @@
+"""Continuous learning on the serving stream (ISSUE 17).
+
+Pins the new subsystem's contracts: the `on_join` hook is bounded and
+error-isolated (a raising subscriber counts
+`quality.join.subscriber_errors`, never kills the evaluator);
+`LabelFeed` is a bounded, loss-counting, deterministically replayable
+bridge from label joins to minibatches; `OnlineLearner` updates at ONE
+fixed (rows, k) shape bucket with snapshot/rewind exactness and
+content-addressed candidates; `ContinuousLearnerMachine` is a pure
+observation->action policy; and the `ContinuousLearner` loop is
+chaos-proven at the seeded `online.refit` site — a crashed refit leaves
+the incumbent serving untouched and the learner rewound, a retried
+refit converges to the exact weights of a fault-free run, and a
+poisoned candidate that burns its canary auto-rolls-back with the
+learner state restored to the pre-refit snapshot.
+
+THE acceptance at the bottom: a seeded 5-sigma covariate shift on a
+LIVE serving worker trips drift, the loop refits from the LabelFeed's
+joined minibatches, the candidate installs, the canary clears, and the
+model promotes — ledger order trip < refit < deploy < promote, zero
+dropped requests, and `plan.recompiles` == 0 for repeated same-bucket
+sparse batches before AND after the hot swap.
+"""
+import functools
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import Table
+from mmlspark_tpu.models.vw.learner import VWParams
+from mmlspark_tpu.online import (ContinuousLearner, ContinuousLearnerMachine,
+                                 LabelFeed, OnlineAction, OnlineConfig,
+                                 OnlineLearner, OnlineObservation)
+from mmlspark_tpu.online import loop as ol
+from mmlspark_tpu.reliability.faults import FaultInjector
+from mmlspark_tpu.reliability.metrics import reliability_metrics
+from mmlspark_tpu.telemetry import lineage as tlineage
+from mmlspark_tpu.telemetry import names as tnames
+from mmlspark_tpu.telemetry import quality as Q
+
+
+@pytest.fixture
+def online_state():
+    """Fresh metrics + monitor + version registry + compile log (these
+    tests pin zero-recompile claims against the process-global log)."""
+    from mmlspark_tpu.telemetry import perf
+    reliability_metrics.reset()
+    Q.reset_monitor()
+    tlineage.reset_version_registry()
+    tlineage.configure_run_ledger(None)
+    perf.get_compile_log().clear()
+    yield
+    perf.get_compile_log().clear()
+    tlineage.configure_run_ledger(None)
+    tlineage.reset_version_registry()
+    Q.reset_monitor()
+    reliability_metrics.reset()
+
+
+def _pairs(seed=0, n=256, k=8, bits=12):
+    """Synthetic hashed sparse pairs over fixed slots + a linear truth."""
+    rng = np.random.default_rng(seed)
+    slots = rng.integers(0, 1 << bits, size=k).astype(np.int32)
+    idx = np.tile(slots, (n, 1))
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    beta = rng.normal(size=k).astype(np.float32)
+    y = (val @ beta > 0).astype(np.float32)
+    return idx, val, y, beta
+
+
+@functools.lru_cache(maxsize=None)
+def _fit_vw(seed=0, n=512, k=8, bits=12):
+    """One fitted sparse-pair incumbent (cached: read-only everywhere)."""
+    from mmlspark_tpu.models.vw.estimators import VowpalWabbitClassifier
+    idx, val, y, beta = _pairs(seed=seed, n=n, k=k, bits=bits)
+    model = VowpalWabbitClassifier(
+        features_col="features", label_col="label", num_bits=bits,
+        num_passes=4).fit(
+            Table({"features_idx": idx, "features_val": val, "label": y}))
+    return model, idx, val, y, beta
+
+
+# ------------------------------------------------ on_join hook (quality)
+def test_on_join_hook_bounded_and_error_isolated(online_state):
+    """Satellite (a): subscribers see every join (including late joins),
+    a raising subscriber is counted and absorbed — later subscribers
+    still run, the evaluator keeps joining — and fan-out is bounded."""
+    ev = Q.StreamingEvaluator(kind="classification")
+    seen, also = [], []
+
+    def bad(rid, pred, label):
+        raise RuntimeError("subscriber bug")
+
+    ev.subscribe(bad)
+    ev.subscribe(lambda rid, pred, label: seen.append((rid, pred, label)))
+    ev.subscribe(lambda rid, pred, label: also.append(rid))
+    ev.record_prediction("a", 1.0)
+    assert ev.record_label("a", 1.0) == "joined"
+    # label-first: the join completes on the late prediction
+    assert ev.record_label("b", 0.0) == "parked"
+    assert ev.record_prediction("b", 0.0) == "late-join"
+    assert seen == [("a", 1.0, 1.0), ("b", 0.0, 0.0)]
+    assert also == ["a", "b"]
+    assert ev.export()["joined"] == 2
+    assert reliability_metrics.get(
+        tnames.QUALITY_JOIN_SUBSCRIBER_ERRORS) == 2
+    # bounded fan-out + callables only
+    with pytest.raises(TypeError):
+        ev.subscribe("not callable")
+    for _ in range(ev.MAX_SUBSCRIBERS - 3):
+        ev.subscribe(lambda *a: None)
+    with pytest.raises(ValueError):
+        ev.subscribe(lambda *a: None)
+    # the on_join= constructor form is the same hook
+    got = []
+    ev2 = Q.StreamingEvaluator(on_join=lambda *a: got.append(a))
+    ev2.record_prediction("x", 2.0)
+    ev2.record_label("x", 2.5)
+    assert got == [("x", 2.0, 2.5)]
+
+
+# ------------------------------------------------ the label feed
+def test_label_feed_joins_bounds_and_replay(online_state):
+    """Feature rows stage under their request ids, joins assemble
+    (features, label, weight) pairs, every loss is counted (join without
+    features, pair overflow), take() pads ragged widths — and replaying
+    the same sequence yields byte-identical minibatches."""
+    def drive(feed):
+        feed.record_features(["r0", "r1"], [[1, 2, 3], [4, 5, 6]],
+                             [[.1, .2, .3], [.4, .5, .6]])
+        feed.record_features(["r2"], [[7, 8]], [[.7, .8]],
+                             weights=[2.0])
+        feed.on_join("r1", 1.0, 1.0)
+        feed.on_join("r0", 0.0, 0.0)
+        feed.on_join("r2", 1.0, 1.0)
+        feed.on_join("ghost", 1.0, 1.0)     # features never staged
+        return feed.take()
+
+    a = drive(LabelFeed())
+    b = drive(LabelFeed())
+    idx, val, y, w = a
+    assert idx.shape == (3, 3) and val.shape == (3, 3)
+    # FIFO join order; r2's 2-wide row right-padded with the zero pair
+    assert idx.tolist() == [[4, 5, 6], [1, 2, 3], [7, 8, 0]]
+    assert y.tolist() == [1.0, 0.0, 1.0] and w.tolist() == [1.0, 1.0, 2.0]
+    for left, right in zip(a, b):
+        assert np.array_equal(left, right)
+    assert reliability_metrics.get(tnames.ONLINE_FEED_DROPPED) == 2
+    assert reliability_metrics.get(tnames.ONLINE_FEED_PAIRS) == 6
+
+    # pair-buffer overflow evicts oldest-first, counted
+    feed = LabelFeed(max_pairs=2)
+    feed.record_features([f"p{i}" for i in range(3)],
+                         np.arange(6).reshape(3, 2),
+                         np.ones((3, 2), np.float32))
+    for i in range(3):
+        feed.on_join(f"p{i}", 1.0, 1.0)
+    assert len(feed) == 2
+    idx2, *_ = feed.take()
+    assert idx2.tolist() == [[2, 3], [4, 5]]          # p0 evicted
+    assert feed.take() is None
+    assert feed.stats()["dropped_total"] == 1
+    assert reliability_metrics.peek_gauge(tnames.ONLINE_BUFFER_PAIRS) == 0
+
+    # feature-window age-out is silent (never a pair, nothing lost)
+    tight = LabelFeed(max_features=2)
+    tight.record_features(["a", "b", "c"], np.zeros((3, 1), np.int32),
+                          np.zeros((3, 1), np.float32))
+    assert tight.stats()["pending_features"] == 2
+
+
+# ------------------------------------------------ the learner
+def test_online_learner_fixed_bucket_and_snapshot_exactness(online_state):
+    """Minibatches chunk+pad to the frozen (rows, k) bucket, the loss
+    falls as updates accumulate, snapshot/restore is bit-exact, and a
+    too-wide minibatch is refused (the bucket is a contract)."""
+    idx, val, y, _ = _pairs(seed=3, n=300, k=8)
+    lrn = OnlineLearner(VWParams(loss_function="logistic", num_bits=12,
+                                 learning_rate=0.5), rows=64)
+    first = lrn.partial_fit(idx[:128], val[:128], y[:128])
+    assert first["updates"] == 2 and first["examples"] == 128
+    assert lrn.k == 8                       # frozen on first contact
+    snap = lrn.snapshot()
+    for _ in range(4):
+        out = lrn.partial_fit(idx, val, y)
+    assert out["loss"] < first["loss"]
+    assert lrn.updates == 2 + 4 * 5         # 300 rows -> 5 chunks of 64
+    assert reliability_metrics.get(tnames.ONLINE_LEARNER_UPDATES) \
+        == lrn.updates
+    # rewind is exact
+    lrn.restore(snap)
+    assert np.array_equal(lrn._weights, snap["weights"])
+    assert lrn._bias == snap["bias"] and lrn.updates == snap["updates"]
+    with pytest.raises(ValueError):
+        lrn.partial_fit(np.zeros((4, 9), np.int32),
+                        np.zeros((4, 9), np.float32), np.zeros(4))
+
+
+def test_online_learner_warm_start_and_candidate_lineage(online_state,
+                                                         tmp_path):
+    """Warm-starting from the incumbent seeds its weights; make_model
+    freezes a content-addressed candidate whose transform matches the
+    incumbent family, stamped with online lineage and journaled to the
+    run ledger — the same record shape batch fits stamp."""
+    ledger = tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+    model, idx, val, y, _ = _fit_vw(0)
+    lrn = OnlineLearner(VWParams(loss_function="logistic",
+                                 num_bits=model.num_bits),
+                        warm_start=model, rows=64)
+    assert np.array_equal(lrn._weights, np.asarray(model._weights))
+    lrn.partial_fit(idx[:64], val[:64], y[:64])
+    cand = lrn.make_model(reference_profile=None, reason="drift")
+    assert cand.lineage["estimator"] == "OnlineLearner"
+    assert cand.lineage["reason"] == "drift"
+    ref = cand.transform(Table({"features_idx": idx[:32],
+                                "features_val": val[:32]}))
+    assert set(np.asarray(ref["prediction"]).tolist()) <= {0.0, 1.0}
+    versions = [r for r in ledger.records() if "content_digest" in r]
+    assert versions, "candidate ModelVersion not journaled"
+    # warm-start dim mismatch is refused loudly
+    with pytest.raises(ValueError):
+        OnlineLearner(VWParams(num_bits=10), warm_start=model)
+
+
+# ------------------------------------------------ pure state machine
+def test_machine_pure_transitions(online_state):
+    sm = ContinuousLearnerMachine(OnlineConfig(min_pairs=8,
+                                               cooldown_polls=2))
+    # quiet and trickle observations do nothing
+    assert sm.on_observation(OnlineObservation()) is None
+    assert sm.on_observation(OnlineObservation(drift_tripped=True,
+                                               pairs=3)) is None
+    act = sm.on_observation(OnlineObservation(drift_tripped=True, pairs=9))
+    assert act == OnlineAction("refit", reason="drift")
+    assert sm.state == ol.REFITTING
+    # observations mid-flight are inert
+    assert sm.on_observation(OnlineObservation(drift_tripped=True,
+                                               pairs=99)) is None
+    assert sm.on_refit_result(True) == OnlineAction("deploy")
+    assert sm.state == ol.CANARYING
+    sm.on_rollout_result(True)
+    assert sm.state == ol.WATCHING and sm.last_outcome == "promoted"
+    # cooldown suppresses exactly cooldown_polls triggers
+    hot = OnlineObservation(floor_burning=True, pairs=99)
+    assert sm.on_observation(hot) is None
+    assert sm.on_observation(hot) is None
+    assert sm.on_observation(hot) == OnlineAction("refit",
+                                                  reason="floor-burn")
+    # a failed refit cools down too
+    assert sm.on_refit_result(False) is None
+    assert sm.state == ol.WATCHING and sm.last_outcome == "refit-failed"
+    # out-of-state calls are no-ops
+    assert sm.on_refit_result(True) is None
+    sm.on_rollout_result(False)
+    assert sm.last_outcome == "refit-failed"
+
+
+def _trigger_once():
+    """Observation schedule: one drift trip, then quiet."""
+    fired = {"n": 0}
+
+    def observe():
+        fired["n"] += 1
+        return OnlineObservation(drift_tripped=fired["n"] == 1, pairs=999)
+    return observe
+
+
+def _loaded_learner_and_feed(seed=0):
+    model, idx, val, y, _ = _fit_vw(seed)
+    lrn = OnlineLearner(VWParams(loss_function="logistic",
+                                 num_bits=model.num_bits),
+                        warm_start=model, rows=64, k=8)
+    feed = LabelFeed()
+    n = 128
+    rids = [f"r{i}" for i in range(n)]
+    feed.record_features(rids, idx[:n], val[:n])
+    for i, rid in enumerate(rids):
+        feed.on_join(rid, 1.0, float(y[i]))
+    return model, lrn, feed
+
+
+# ------------------------------------------------ chaos: online.refit
+def test_refit_crash_chaos_rewinds_and_retry_converges(online_state,
+                                                       tmp_path):
+    """Satellite (d), half one: a seeded crash at the `online.refit`
+    site mid-refit (state already dirty) rewinds to the pre-refit
+    snapshot and the bounded retry converges to EXACTLY the weights a
+    fault-free run produces — while a crash that exhausts every attempt
+    leaves the learner bit-identical to its snapshot and journals no
+    refit/deploy events after the trip."""
+    ledger = tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+
+    # fault-free control run over the identical replayed feed
+    _, clean_lrn, clean_feed = _loaded_learner_and_feed(0)
+    clean = ContinuousLearner(clean_lrn, clean_feed,
+                              deploy=lambda m: True,
+                              observe=_trigger_once(),
+                              config=OnlineConfig(min_pairs=8),
+                              sleep=lambda s: None)
+    assert clean.run_once()["outcome"] == "promoted"
+
+    # crash at occurrence 0 -> one retry -> identical weights
+    inj = FaultInjector(seed=5, rules=[
+        {"site": "online.refit", "kind": "crash", "at": [0]}])
+    _, lrn, feed = _loaded_learner_and_feed(0)
+    cl = ContinuousLearner(lrn, feed, deploy=lambda m: True,
+                           observe=_trigger_once(),
+                           config=OnlineConfig(min_pairs=8),
+                           ledger=ledger, faults=inj,
+                           sleep=lambda s: None)
+    status = cl.run_once()
+    assert status["outcome"] == "promoted", status
+    assert reliability_metrics.get(tnames.ONLINE_REFIT_RETRIES) == 1
+    assert np.array_equal(lrn._weights, clean_lrn._weights)
+    assert lrn._bias == clean_lrn._bias
+    events = [r["event"] for r in ledger.records() if "event" in r]
+    order = [tnames.ONLINE_TRIP_EVENT, tnames.ONLINE_REFIT_EVENT,
+             tnames.ONLINE_DEPLOY_EVENT, tnames.ONLINE_PROMOTE_EVENT]
+    idx = [events.index(e) for e in order]
+    assert idx == sorted(idx), events
+
+    # crash on EVERY attempt -> refit-failed, learner untouched, the
+    # deploy callable (the incumbent's gate) never runs
+    inj2 = FaultInjector(seed=5, rules=[
+        {"site": "online.refit", "kind": "crash", "at": [0, 1, 2]}])
+    _, lrn2, feed2 = _loaded_learner_and_feed(0)
+    snap = lrn2.snapshot()
+    deployed = []
+    ledger2 = tlineage.configure_run_ledger(str(tmp_path / "r2.jsonl"))
+    cl2 = ContinuousLearner(lrn2, feed2,
+                            deploy=lambda m: deployed.append(m) or True,
+                            observe=_trigger_once(),
+                            config=OnlineConfig(min_pairs=8),
+                            ledger=ledger2, faults=inj2,
+                            sleep=lambda s: None)
+    status2 = cl2.run_once()
+    assert status2["outcome"] == "refit-failed", status2
+    assert deployed == []                       # incumbent never touched
+    assert np.array_equal(lrn2._weights, snap["weights"])
+    assert lrn2._acc.sum() == snap["acc"].sum() == 0.0
+    ev2 = [r["event"] for r in ledger2.records() if "event" in r]
+    assert ev2 == [tnames.ONLINE_TRIP_EVENT]
+    assert cl2.machine.state == ol.WATCHING
+
+
+def test_poisoned_refit_burns_canary_and_rolls_back(online_state,
+                                                    tmp_path):
+    """Satellite (d), half two: the refit succeeds but the candidate
+    burns its canary — the REAL RolloutDriver rolls the serving worker
+    back to the incumbent, and the loop rewinds the learner to the
+    pre-refit snapshot so the rejected update leaves no trace."""
+    from mmlspark_tpu.control import (Observation, RolloutConfig,
+                                      RolloutDriver)
+    from mmlspark_tpu.control import rollout as ctl
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    ledger = tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+    inc, lrn, feed = _loaded_learner_and_feed(0)
+    worker = compile_serving_transform(inc, ["features_idx",
+                                             "features_val"])
+    inc_version = worker.version
+    snap = lrn.snapshot()
+
+    def deploy(candidate):
+        sched = iter([Observation(burning=True), Observation(),
+                      Observation(), Observation()])
+        drv = RolloutDriver(
+            {"w0": worker}, inc, candidate,
+            observe=lambda: next(sched), ledger=ledger,
+            config=RolloutConfig(traffic_steps=(1.0,), step_polls=2,
+                                 poll_interval_s=0.0, recover_polls=1),
+            sleep=lambda s: None)
+        return drv.run()["state"] == ctl.PROMOTED
+
+    cl = ContinuousLearner(lrn, feed, deploy=deploy,
+                           observe=_trigger_once(),
+                           config=OnlineConfig(min_pairs=8),
+                           ledger=ledger, sleep=lambda s: None)
+    status = cl.run_once()
+    assert status["outcome"] == "rolled-back", status
+    assert worker.version == inc_version        # incumbent serves again
+    assert np.array_equal(lrn._weights, snap["weights"])
+    assert lrn.refits == snap["refits"]
+    assert reliability_metrics.get(tnames.ONLINE_ROLLBACKS) == 1
+    assert reliability_metrics.get(tnames.ONLINE_PROMOTIONS) == 0
+    events = [r["event"] for r in ledger.records() if "event" in r]
+    order = [tnames.ONLINE_TRIP_EVENT, tnames.ONLINE_REFIT_EVENT,
+             tnames.ONLINE_DEPLOY_EVENT,
+             tnames.CONTROL_ROLLOUT_ROLLBACK_EVENT,
+             tnames.ONLINE_ROLLBACK_EVENT]
+    idx = [events.index(e) for e in order]
+    assert idx == sorted(idx), events
+    assert tnames.ONLINE_PROMOTE_EVENT not in events
+
+
+# ------------------------------------------------ THE acceptance (e2e)
+def test_self_healing_shift_refit_promote_zero_drops(online_state,
+                                                     tmp_path):
+    """THE tentpole acceptance: seeded 5-sigma covariate shift on a
+    LIVE serving worker -> drift trips -> ContinuousLearner refits from
+    LabelFeed minibatches -> candidate installs -> canary clears ->
+    promote. Ledger order trip < refit < deploy < promote, ZERO dropped
+    requests through the whole window, and `plan.recompiles` == 0 for
+    repeated same-bucket sparse batches before AND after the swap."""
+    from mmlspark_tpu.control import (Observation, RolloutConfig,
+                                      RolloutDriver)
+    from mmlspark_tpu.control import rollout as ctl
+    from mmlspark_tpu.io.serving import serve_pipeline
+
+    ledger = tlineage.configure_run_ledger(str(tmp_path / "runs.jsonl"))
+    inc, idx, val, y, beta = _fit_vw(0)
+    k = idx.shape[1]
+    # the 5-sigma shift: unit-variance features pushed 5 std devs along
+    # the truth direction — the incumbent's predictions collapse to one
+    # class and the prediction-column PSI blows through any ceiling
+    shift = (5.0 * beta / np.linalg.norm(beta)).astype(np.float32)
+
+    server, q = serve_pipeline(inc, input_cols=["features_idx",
+                                                "features_val"],
+                               mode="continuous")
+    statuses = []
+    try:
+        mon = Q.get_monitor()
+        assert mon.active, "VW fit did not stamp a quality profile"
+        mon.configure(sample=1.0, min_live=24)
+        feed = LabelFeed(evaluator=mon.evaluator)
+        lrn = OnlineLearner(VWParams(loss_function="logistic",
+                                     num_bits=inc.num_bits),
+                            warm_start=inc, rows=64, k=k)
+
+        def post(row_idx, row_val, label):
+            body = json.dumps({"features_idx": row_idx.tolist(),
+                               "features_val": row_val.tolist()}).encode()
+            req = urllib.request.Request(
+                server.address, data=body,
+                headers={"Content-Type": "application/json"})
+            resp = urllib.request.urlopen(req, timeout=15)
+            resp.read()
+            statuses.append(resp.status)
+            rid = resp.headers["X-Request-Id"]
+            feed.record_features([rid], row_idx[None, :], row_val[None, :])
+            Q.record_label(rid, float(label))
+
+        # phase 1: in-distribution traffic (baseline, no trip)
+        for i in range(8):
+            post(idx[i], val[i], y[i])
+        recompiles_before = reliability_metrics.get(
+            tnames.PLAN_RECOMPILES)
+
+        def deploy(candidate):
+            sched = iter([Observation()] * 10)
+            drv = RolloutDriver(
+                {"w0": q.transform_fn}, inc, lambda: candidate,
+                observe=lambda: next(sched), ledger=ledger,
+                config=RolloutConfig(traffic_steps=(1.0,), step_polls=1,
+                                     soak_polls=1, poll_interval_s=0.0),
+                sleep=lambda s: None)
+            return drv.run()["state"] == ctl.PROMOTED
+
+        cl = ContinuousLearner(
+            lrn, feed, deploy=deploy,
+            config=OnlineConfig(min_pairs=32, max_drift=0.5,
+                                poll_interval_s=0.0),
+            ledger=ledger, sleep=lambda s: None)
+
+        # no shift yet: the loop watches and does nothing
+        assert cl.run_once()["action"] is None
+
+        # phase 2: the shift arrives on live traffic
+        shifted = val + shift
+        y_shift = (shifted @ beta > 0).astype(np.float32)
+        for i in range(72):
+            post(idx[i], shifted[i], y_shift[i])
+        assert all(s == 200 for s in statuses)   # zero dropped so far
+
+        status = cl.run_once()
+        assert status.get("outcome") == "promoted", status
+        assert q.transform_fn.version != tlineage.model_version(
+            inc).version
+        assert reliability_metrics.get(tnames.ONLINE_TRIPS) == 1
+        assert reliability_metrics.get(tnames.ONLINE_PROMOTIONS) == 1
+
+        # the promoted candidate serves the SAME bucket: repeated
+        # batches after the swap, still zero drops, zero recompiles
+        for i in range(8):
+            post(idx[i], shifted[i], y_shift[i])
+        assert all(s == 200 for s in statuses)
+        assert len(statuses) == 88
+        assert reliability_metrics.get(tnames.PLAN_RECOMPILES) \
+            == recompiles_before == 0
+
+        # the fresh reference re-baselined drift: the healed model does
+        # not keep tripping on the incumbent's frozen profile
+        obs = cl._default_observe()
+        assert not obs.drift_tripped, obs
+
+        events = [r["event"] for r in ledger.records() if "event" in r]
+        order = [tnames.ONLINE_TRIP_EVENT, tnames.ONLINE_REFIT_EVENT,
+                 tnames.ONLINE_DEPLOY_EVENT,
+                 tnames.CONTROL_ROLLOUT_PROMOTE_EVENT,
+                 tnames.ONLINE_PROMOTE_EVENT]
+        order_idx = [events.index(e) for e in order]
+        assert order_idx == sorted(order_idx), events
+        trip = next(r for r in ledger.records()
+                    if r.get("event") == tnames.ONLINE_TRIP_EVENT)
+        assert trip["reason"] == "drift" and trip["pairs"] >= 32
+    finally:
+        q.stop()
+        server.stop()
