@@ -24,6 +24,7 @@ ENTRYPOINTS: Tuple[Tuple[str, str], ...] = (
     ("mmlspark_tpu.models.gbdt.boosting", "gbdt_fused_chunk_contract"),
     ("mmlspark_tpu.models.gbdt.distributed", "gbdt_chunk_distributed_contract"),
     ("mmlspark_tpu.models.gbdt.distributed", "gbdt_tree_distributed_contract"),
+    ("mmlspark_tpu.models.gbdt.distributed", "gbdt_vote_distributed_contract"),
     ("mmlspark_tpu.online.learner", "online_update_contract"),
     ("mmlspark_tpu.ops.histogram", "gbdt_hist_route_contract"),
 )

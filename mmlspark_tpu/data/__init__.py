@@ -13,6 +13,8 @@ from .pool import WorkerCrashError, WorkerPool
 from .prefetch import DevicePrefetcher, prefetch_to_device
 from .pipeline import (IngestOptions, IngestPipeline, ParallelTransform,
                        parallel_apply_bins, profile_columns, stage_binned)
+from .oocore import ChunkStager, OocoreOptions
+from .planner import ChunkPlanner
 
 __all__ = [
     "Chunk", "ChunkSource", "default_chunk_rows", "make_chunks",
@@ -20,4 +22,5 @@ __all__ = [
     "DevicePrefetcher", "prefetch_to_device",
     "IngestOptions", "IngestPipeline", "ParallelTransform",
     "parallel_apply_bins", "profile_columns", "stage_binned",
+    "ChunkStager", "OocoreOptions", "ChunkPlanner",
 ]

@@ -385,6 +385,10 @@ def fit_booster(x: np.ndarray, y: np.ndarray, params: BoostParams,
     fault, bad params, device OOM) still lands in the span log with its
     error — per-iteration/per-chunk children attach through the activated
     context inside."""
+    if isinstance(x, str):
+        # out-of-core source: an .npy path memory-maps here so nothing
+        # below this line ever holds the raw matrix host-resident
+        x = np.load(x, mmap_mode="r")
     _tel = get_tracer()
     span = _tel.start_span(tnames.GBDT_FIT_SPAN, attrs={
         "rows": int(x.shape[0]), "features": int(x.shape[1]),
@@ -414,7 +418,7 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
                       prebinned: Optional[tuple] = None,
                       presence: Optional[np.ndarray] = None,
                       checkpoint_fn=None, checkpoint_interval: int = 25,
-                      init_base: float = 0.0, ingest=None,
+                      init_base: float = 0.0, ingest=None, oocore=None,
                       init_margin: Optional[np.ndarray] = None,
                       init_rng_key: Optional[np.ndarray] = None,
                       iter_offset: int = 0, step_clock=None):
@@ -427,6 +431,10 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
     per-chunk device_put (data.stage_binned) instead of the serial
     whole-matrix staging — the Spark-partitioned-ingest analog. Output is
     bit-identical to the sequential path (tests/test_data_pipeline.py).
+    `oocore` (a data.OocoreOptions) takes precedence and streams chunked
+    binning under a bounded residency budget with a durable mid-dataset
+    resume cursor — the out-of-core path for sources larger than host RAM
+    (`x` may be an .npy path; docs/gbdt.md "Out-of-core training").
 
     Padded rows (distributed ragged handling) carry weight 0 and therefore
     contribute nothing to histograms, leaf values, or the init score.
@@ -515,7 +523,15 @@ def _fit_booster_impl(x: np.ndarray, y: np.ndarray,
             mapper = binning.fit_bins(
                 x, max_bin=p.max_bin, seed=p.seed,
                 categorical_features=p.categorical_features)
-        if ingest is not None:
+        if oocore is not None:
+            # out-of-core: stream chunked binning under the residency
+            # budget; the stager hands put_fn (sharded placement) the
+            # assembled uint8 cache, or feeds a donated device buffer
+            # per chunk on accelerators (data/oocore.py)
+            from ...data.oocore import ChunkStager
+            stager = ChunkStager(x, mapper, oocore)
+            d_bins = stager.stage(put=put_fn)
+        elif ingest is not None:
             from ...data import parallel_apply_bins, stage_binned
             if put_fn is None:
                 # single-device: chunk binning overlaps the device feed

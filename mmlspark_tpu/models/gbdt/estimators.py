@@ -12,6 +12,7 @@ predict/leaf-index/SHAP output columns like LightGBMModelMethods
 from __future__ import annotations
 
 import dataclasses
+import os
 
 from typing import Optional
 
@@ -116,6 +117,21 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         "ingest_prefetch",
         "bounded host->device prefetch depth (double buffer)", 2,
         validator=in_range(1))
+
+    # out-of-core staging (data/oocore.py; docs/gbdt.md "Out-of-core
+    # training"): stream chunked binning under a bounded raw-bytes
+    # residency budget with a durable mid-dataset resume cursor. The
+    # spill cache lands next to the checkpoints when checkpoint_dir is
+    # set, so a preempted fit resumes staging where it died.
+    out_of_core = Param(
+        "out_of_core",
+        "stream chunked binning under max_resident_bytes instead of "
+        "staging the whole matrix (bit-identical output)", False)
+    max_resident_bytes = Param(
+        "max_resident_bytes",
+        "out-of-core residency budget for raw input bytes held host-"
+        "resident at once (0 = one auto ~32MB chunk window)", 0,
+        validator=in_range(0))
 
     checkpoint_dir = Param(
         "checkpoint_dir",
@@ -230,6 +246,20 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
                                    mode=self.ingest_mode,
                                    chunk_rows=self.ingest_chunk_rows,
                                    prefetch=self.ingest_prefetch)
+        oocore = None
+        if self.out_of_core:
+            from ...data import OocoreOptions
+            cache = None
+            if self.checkpoint_dir:
+                cache = os.path.join(self.checkpoint_dir, "oocore_bins.npy")
+            oocore = OocoreOptions(
+                max_resident_bytes=self.max_resident_bytes,
+                cache_path=cache,
+                num_workers=self.num_ingest_workers,
+                mode=("thread" if self.ingest_mode == "auto"
+                      else self.ingest_mode),
+                chunk_rows=self.ingest_chunk_rows,
+                prefetch=self.ingest_prefetch)
 
         # step-level checkpoint/resume (SURVEY.md §5); single-batch fits only
         ck_fn, resume_booster, done, resume_base = None, None, 0, 0.0
@@ -282,10 +312,22 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
 
             def ck_fn(it, booster, fit_base, final=False, margin=None,
                       rng_key=None, _mgr=mgr, _done=done,
-                      _denom=params.rf_total or params.num_iterations):
+                      _denom=params.rf_total or params.num_iterations,
+                      _oocore=bool(self.out_of_core)):
                 payload = {"booster": booster.save_model_string(),
                            "iteration": _done + it, "base": float(fit_base),
                            "final": bool(final), "rf_denom": int(_denom)}
+                if _oocore:
+                    # the durable staging cursor rides the supervisor/
+                    # checkpoint payload for observability; the cursor's
+                    # source of truth for resume is the spill-cache
+                    # sidecar (data/oocore.py), which survives kills the
+                    # checkpoint cadence would miss
+                    from ...reliability.metrics import reliability_metrics
+                    from ...telemetry import names as _tn
+                    cur = reliability_metrics.peek_gauge(
+                        _tn.DATA_OOCORE_CURSOR)
+                    payload["oocore_cursor"] = int(cur or 0)
                 if margin is not None:
                     payload["margin"] = np.asarray(margin, np.float32)
                 if rng_key is not None:
@@ -302,9 +344,11 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             from .distributed import fit_booster_distributed
             fit = lambda **kw: fit_booster_distributed(
                 parallelism=self.parallelism, top_k=self.top_k,
-                num_tasks=self.num_tasks, ingest=ingest, **kw)
+                num_tasks=self.num_tasks, ingest=ingest, oocore=oocore,
+                **kw)
         else:
-            fit = lambda **kw: fit_booster(ingest=ingest, **kw)
+            fit = lambda **kw: fit_booster(ingest=ingest, oocore=oocore,
+                                           **kw)
         if n_batches > 1:
             # batch continuation (reference: LightGBMBase.scala:34-51)
             booster, base, hist = None, 0.0, []

@@ -257,7 +257,12 @@ def _voting_feature_mask(hg, hh, hc, feature_mask, cfg: TreeConfig,
     order = jnp.argsort(-per_feat, axis=-1)
     rank = jnp.argsort(order, axis=-1)
     votes = (rank < k) & jnp.isfinite(per_feat) & (per_feat > -jnp.inf)
-    tally = jax.lax.psum(votes.astype(jnp.float32), axis_name)  # (m, F)
+    # int32 tally: vote counts are small exact integers (<= host count),
+    # and argsort tie-breaks by feature id identically for s32 and f32 —
+    # same election, integer wire format (the vote all-reduce is the only
+    # collective the voting mode adds; keep it an integer count, not a
+    # float reinterpretation of one)
+    tally = jax.lax.psum(votes.astype(jnp.int32), axis_name)  # (m, F)
     # global selection: top 2k by vote count (ties broken by feature id).
     # Returns the winners as INDICES (m, 2k) + their got-a-vote mask so
     # the caller can all-reduce only the voted features' histograms —

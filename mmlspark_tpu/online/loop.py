@@ -166,8 +166,15 @@ class ContinuousLearner:
 
     # -- signals --------------------------------------------------------------
     def _default_observe(self) -> OnlineObservation:
-        """Drift trip from the live quality monitor; floor burn is the
-        injected observer's job (it needs an SLO window to read)."""
+        """Drift trip from the live quality monitor + floor burn from the
+        process SLO engine's windowed verdict (telemetry/slo.py): an
+        objective burning in BOTH its short and long windows — e.g. a
+        quality-metric floor via `quality_objectives(metric_floor=...)` —
+        flips `floor_burning`, so a model whose live metric sinks below
+        the floor refits even when its feature distributions never
+        drifted. The engine's no-data rule ("absence of evidence is not a
+        burn") keeps an unconfigured or idle engine from false-tripping.
+        An injected observer remains the test seam for both signals."""
         from ..telemetry import quality as tquality
         mon = tquality.get_monitor()
         worst, worst_col = 0.0, None
@@ -181,7 +188,20 @@ class ContinuousLearner:
         tripped = worst > self.config.max_drift
         detail = ({"psi": round(worst, 4), "col": worst_col}
                   if tripped else None)
+        burning = False
+        try:
+            from ..telemetry import slo as tslo
+            verdict = tslo.get_engine().verdict(notify=False)
+            hot = sorted(o["objective"]["name"]
+                         for o in verdict.get("objectives", ())
+                         if o.get("burning"))
+            burning = bool(hot)
+            if burning and detail is None:
+                detail = {"slo": hot}
+        except Exception:  # noqa: BLE001 - observation must not kill the loop
+            burning = False
         return OnlineObservation(drift_tripped=tripped,
+                                 floor_burning=burning,
                                  pairs=len(self.feed), detail=detail)
 
     def _journal(self, event: str, **attrs) -> None:

@@ -251,7 +251,8 @@ class TrainingSupervisor:
                  manager: Optional[CheckpointManager] = None,
                  metrics=None, faults: Optional[FaultInjector] = None,
                  step_clock=None, straggler=None,
-                 straggler_threshold: float = 1.5):
+                 straggler_threshold: float = 1.5,
+                 chunk_planner=None):
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self.checkpoint_every = max(int(checkpoint_every), 0)  # 0 = final only
@@ -283,6 +284,11 @@ class TrainingSupervisor:
                                           threshold=straggler_threshold,
                                           registry=self.metrics)
         self.straggler = straggler or None
+        # straggler ACTUATION (data/planner.py): flagged hosts from the
+        # detector's beat-time check drain their pending out-of-core
+        # chunks to healthy peers; detection stays pure observability
+        # when no planner is handed in
+        self.chunk_planner = chunk_planner
         self.resumed_step: Optional[int] = None
         self._resumed_results: list = []
         self._last: Optional[tuple] = None   # (step, payload, results) rewind
@@ -516,7 +522,17 @@ class TrainingSupervisor:
             logger.warning("heartbeat update failed (%s: %s)",
                            type(e).__name__, e)
         if step is not None and self.straggler is not None:
-            self.straggler.check()   # never raises (observability)
+            flagged = self.straggler.check()   # never raises (observability)
+            if flagged and self.chunk_planner is not None:
+                # actuation: drain the flagged hosts' pending chunks
+                # (ordered AFTER the train.straggler event the check just
+                # emitted). Re-planning failure must not kill training —
+                # the straggler then simply keeps its chunks.
+                try:
+                    self.chunk_planner.reassign(flagged)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("chunk reassignment failed (%s: %s)",
+                                   type(e).__name__, e)
 
     def _mark(self, step: int, results: list, write: bool) -> None:
         t0 = time.perf_counter()

@@ -265,6 +265,8 @@ CANARY_P99_RATIO = "canary.p99.ratio"
 CANARY_ERROR_BURN = "canary.error_burn"
 CANARY_DRIFT_DELTA = "canary.drift.delta"
 CONTROL_ROLLOUT_FRACTION = "control.rollout.fraction"
+DATA_OOCORE_RESIDENT_BYTES = "data.oocore.resident_bytes"
+DATA_OOCORE_CURSOR = "data.oocore.cursor"
 
 GAUGES = {
     ANALYSIS_SEMANTIC_CONTRACTS: "hot-path contracts analyzed by the last "
@@ -312,6 +314,13 @@ GAUGES = {
     CONTROL_ROLLOUT_FRACTION: "traffic fraction the rollout driver "
                               "currently targets for the candidate "
                               "(0 after rollback, 1 at/after promote)",
+    DATA_OOCORE_RESIDENT_BYTES: "raw-input bytes the out-of-core stager "
+                                "may hold host-resident at once (the "
+                                "bounded in-flight window, not the full "
+                                "dataset)",
+    DATA_OOCORE_CURSOR: "chunks durably binned into the out-of-core "
+                        "spill cache so far (the resume cursor a killed "
+                        "staging pass restarts from)",
     "control.router.weight.{target}": "weighted-router relative weight "
                                       "per target (host:port), 1..100 — "
                                       "scaled from scraped queue depth "
@@ -423,6 +432,7 @@ TRAIN_RESUME_EVENT = "train.resume"
 TRAIN_RESTART_EVENT = "train.restart"
 TRAIN_PREEMPTED_EVENT = "train.preempted"
 TRAIN_STRAGGLER_EVENT = "train.straggler"
+TRAIN_CHUNK_REASSIGN_EVENT = "train.chunk.reassign"
 TELEMETRY_BUNDLE_EVENT = "telemetry.bundle"
 TELEMETRY_PROFILE_EVENT = "telemetry.profile"
 TELEMETRY_WATCH_TRIP_EVENT = "telemetry.watch.trip"
@@ -444,6 +454,11 @@ EVENTS = {
     TRAIN_STRAGGLER_EVENT: "a host's windowed step p50 deviated beyond "
                            "the straggler threshold (host, p50, fleet "
                            "median attrs)",
+    TRAIN_CHUNK_REASSIGN_EVENT: "ChunkPlanner drained a flagged host's "
+                                "pending chunks to healthy hosts "
+                                "(from_host, to_hosts, chunks attrs) — "
+                                "ordered after the train.straggler flag "
+                                "that triggered it",
     TELEMETRY_BUNDLE_EVENT: "one flight-recorder bundle written (reason, "
                             "path)",
     TELEMETRY_PROFILE_EVENT: "one device-profile capture written "
@@ -505,6 +520,19 @@ FAULT_SITES = {
     "cluster.heartbeat": "Heartbeat.beat() before the atomic write",
     "data.worker.chunk{index}": "ingest pool, fired before chunk i's "
                                 "transform",
+    "data.oocore.stage{index}": "out-of-core stager, fired before chunk "
+                                "i's binned rows are written to the "
+                                "spill cache (kind `error` aborts "
+                                "staging mid-dataset — the durable "
+                                "cursor resumes from the last flushed "
+                                "chunk; `delay` stretches staging so a "
+                                "SIGTERM can land mid-epoch)",
+    "data.planner.reassign": "ChunkPlanner.reassign, fired before the "
+                             "pending-chunk migration commits (kind "
+                             "`error` skips this reassignment round — "
+                             "the flagged host keeps its chunks until "
+                             "the next straggler check; `delay` "
+                             "stretches the actuation)",
     "fuzz.http": "corrupt_bytes stream for the malformed-HTTP fuzz "
                  "corpus",
     "checkpoint": "corrupt_file default site (checkpoint corruption "
@@ -526,6 +554,15 @@ FAULT_SITES = {
                     "and retries — counted online.refit_retries; the "
                     "incumbent keeps serving throughout)",
 }
+
+# ------------------------------------------- benchdiff record names
+# Not registry metrics (nothing inc()s or gauges them): these are the
+# canonical names of JSON records bench.py emits and benchdiff gates.
+# They live here so the bench writer and the gate assertions share one
+# spelling (docs/observability.md "MULTICHIP rounds gate like bench
+# rounds" describes the record shape benchdiff gates).
+COMM_GBDT_VOTE_OPS = "comm.gbdt.vote.ops"
+COMM_GBDT_VOTE_BYTES = "comm.gbdt.vote.bytes"
 
 
 # ------------------------------------------------- patterned-name helpers

@@ -508,3 +508,30 @@ def test_self_healing_shift_refit_promote_zero_drops(online_state,
     finally:
         q.stop()
         server.stop()
+
+
+def test_default_observe_floor_burn_from_slo_window():
+    """ISSUE 18 satellite: the default observer's floor-burn signal reads
+    the process SLO engine's REAL windowed verdict (telemetry/slo.py), not
+    a placeholder — a quality-metric floor burning in both windows trips
+    the refit trigger, no-data does not, and recovery clears it."""
+    from mmlspark_tpu.telemetry import slo as tslo
+    metric = "quality.eval.accuracy"
+    cl = ContinuousLearner(None, [], deploy=lambda m: True,
+                           sleep=lambda s: None)
+    reliability_metrics.reset("quality.")
+    tslo.configure(tslo.quality_objectives(metric_floor=0.8))
+    try:
+        # absence of evidence is not a burn: an idle engine stays quiet
+        assert not cl._default_observe().floor_burning
+        reliability_metrics.set_gauge(metric, 0.92)   # above the floor
+        assert not cl._default_observe().floor_burning
+        reliability_metrics.set_gauge(metric, 0.41)   # sunk below it
+        obs = cl._default_observe()
+        assert obs.floor_burning and obs.triggered
+        assert obs.detail == {"slo": ["quality.metric.floor"]}
+        reliability_metrics.set_gauge(metric, 0.95)   # recovered
+        assert not cl._default_observe().floor_burning
+    finally:
+        tslo.configure()                   # restore the process defaults
+        reliability_metrics.reset("quality.")

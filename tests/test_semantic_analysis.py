@@ -88,7 +88,8 @@ def test_serving_plan_compiles_once_per_bucket(shipped):
 def test_distributed_collective_check_is_not_vacuous(shipped):
     # the 8-virtual-device CPU mesh must lower REAL all-reduces into
     # the optimized module, or the budget checker is checking nothing
-    for cname in ("gbdt.tree.distributed", "gbdt.chunk.distributed"):
+    for cname in ("gbdt.tree.distributed", "gbdt.vote.distributed",
+                  "gbdt.chunk.distributed"):
         for case, kinds in shipped.stats[cname]["collectives"].items():
             assert kinds.get("all-reduce", {}).get("ops", 0) >= 1, (
                 cname, case, kinds)
