@@ -116,3 +116,19 @@ class ChunkPlanner:
                 except Exception:  # noqa: BLE001 - journal, not control
                     pass
         return moved
+
+    def remove_hosts(self, dead) -> Dict[int, tuple]:
+        """Permanently drop `dead` hosts from the rotation, draining their
+        pending chunks onto the survivors first (same journaled move as
+        `reassign`). Unlike a straggler drain the dead hosts leave
+        `self.hosts`, so later reassignment rounds never route anything
+        back to them. Returns the moved chunks; empty when no listed host
+        was in the plan or no survivors would remain (shrinking to an
+        empty fleet is not a plan)."""
+        bad = set(int(h) for h in dead) & set(self.hosts)
+        survivors = [h for h in self.hosts if h not in bad]
+        if not bad or not survivors:
+            return {}
+        moved = self.reassign(sorted(bad))
+        self.hosts = survivors
+        return moved

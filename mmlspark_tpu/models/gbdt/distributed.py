@@ -126,15 +126,21 @@ def fit_booster_distributed(x, y, params, weights=None, init_scores=None,
                             checkpoint_fn=None, checkpoint_interval: int = 25,
                             init_base: float = 0.0, ingest=None, oocore=None,
                             init_margin=None, init_rng_key=None,
-                            iter_offset: int = 0):
+                            iter_offset: int = 0, mesh=None):
     """Same training loop as fit_booster, with rows sharded over the mesh.
 
     Split decisions are computed identically on every shard from the psum'd
     histograms, so trees come back replicated — the reference ships the
     booster from worker 0 through a kryo reduce (LightGBMBase.scala:256-264);
     here there is nothing to ship.
+
+    `mesh` overrides the default device mesh — the elastic shrink-resume
+    path (reliability/elastic.py) passes `ElasticPlan.mesh()` here so the
+    survivors' fit compiles for THEIR device set; a new mesh is a new
+    `AotCache` fingerprint, so those recompiles are recorded honestly.
     """
-    mesh = data_mesh(num_tasks if num_tasks > 1 else None)
+    if mesh is None:
+        mesh = data_mesh(num_tasks if num_tasks > 1 else None)
     nsh = mesh.shape[DATA_AXIS]
     if isinstance(x, str):
         # out-of-core source: memory-map here; the f32 asarray below is a

@@ -173,6 +173,50 @@ def barrier(name: str = "barrier") -> None:
         multihost_utils.sync_global_devices(name)
 
 
+class FencedOut(RuntimeError):
+    """A beat was rejected by the epoch fence: this host was declared dead
+    (reliability.elastic.HostLeases) and its fencing token is stale. The
+    row is NOT written — a zombie resuming after its death verdict must
+    not corrupt the survivor plan. A legitimately restarted process
+    adopts the current fence at `Heartbeat.__init__` (or via
+    `adopt_fence()`) and beats normally."""
+
+
+# shared fence table in the heartbeat directory: process_id -> minimum
+# fence epoch a beat must carry to be accepted
+_FENCES_FILE = "fences.json"
+# another host's leaked beat tmp is swept only once it is older than any
+# plausible in-flight write (our OWN stale tmps are swept unconditionally)
+_TMP_STALE_S = 60.0
+
+
+def read_fences(directory: str) -> dict:
+    """The fence table ({process_id: epoch}); empty when absent/torn."""
+    try:
+        with open(os.path.join(directory, _FENCES_FILE)) as f:
+            raw = json.load(f)
+        return {int(k): int(v) for k, v in raw.items()}
+    except (OSError, ValueError, AttributeError):
+        return {}
+
+
+def bump_fence(directory: str, process_id: int) -> int:
+    """Raise `process_id`'s required fence epoch (atomic tmp+replace) and
+    return the new value. Concurrent observers racing the read-modify-
+    write both land a value above the zombie's adopted epoch, so the
+    fence holds whichever write wins."""
+    fences = read_fences(directory)
+    pid = int(process_id)
+    fences[pid] = fences.get(pid, 0) + 1
+    tmp = os.path.join(directory, f"{_FENCES_FILE}.{os.getpid()}.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({str(k): v for k, v in sorted(fences.items())}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(directory, _FENCES_FILE))
+    return fences[pid]
+
+
 class Heartbeat:
     """Lightweight per-process heartbeat/epoch file — how a restarted host
     detects it is REJOINING a training job rather than starting one.
@@ -187,6 +231,13 @@ class Heartbeat:
     seeded `cluster.heartbeat` fault site so heartbeat loss is
     chaos-testable; `clear()` removes the file on a CLEAN finish so the
     next run starts fresh.
+
+    Beats are epoch-fenced (docs/reliability.md "Elastic multi-host
+    training"): every row carries the fence epoch this instance adopted
+    at construction, and `beat()` re-checks the shared fence table before
+    writing — a zombie process declared dead by `HostLeases` holds a
+    stale token and gets `FencedOut` instead of a write, while a real
+    restart (fresh instance) adopts the bumped fence and rejoins.
     """
 
     def __init__(self, directory: str, process_id: Optional[int] = None,
@@ -204,12 +255,51 @@ class Heartbeat:
                                  f"heartbeat_{self.process_id}.json")
         self._metrics = metrics if metrics is not None else reliability_metrics
         self._faults = faults if faults is not None else FaultInjector.from_env()
+        self._sweep_stale_tmps()
+        self.fence_epoch = self.adopt_fence()
         prior = self.read()
         self.resume_epoch: Optional[int] = (
             None if prior is None else int(prior.get("epoch", 0)))
         if prior is not None:
             self._metrics.set_gauge(tnames.CLUSTER_RESUME_EPOCH, self.resume_epoch)
             self._metrics.inc(tnames.CLUSTER_REJOINS)
+
+    def _sweep_stale_tmps(self) -> None:
+        """Remove beat tmp files leaked by a crash between the tmp write
+        and its os.replace. Our OWN file's tmps can have no live writer
+        at construction time and go unconditionally; another host's tmp
+        is deleted only past _TMP_STALE_S (it may be mid-replace)."""
+        own_prefix = f"heartbeat_{self.process_id}.json."
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return
+        now = wall_now()
+        swept = 0
+        for fname in names:
+            if not (fname.startswith("heartbeat_")
+                    and fname.endswith(".tmp")):
+                continue
+            path = os.path.join(self.directory, fname)
+            try:
+                if not fname.startswith(own_prefix):
+                    if now - os.stat(path).st_mtime < _TMP_STALE_S:
+                        continue
+                os.remove(path)
+                swept += 1
+            except OSError:
+                continue
+        if swept:
+            self._metrics.inc(tnames.CLUSTER_HEARTBEAT_TMP_SWEPT, swept)
+
+    def adopt_fence(self) -> int:
+        """(Re-)read the shared fence table and adopt this process's
+        current epoch — the legitimate-rejoin path after a false-positive
+        death verdict (the chaos-pinned `cluster.lease.expire` recovery:
+        one rejected beat, then rejoin)."""
+        self.fence_epoch = read_fences(self.directory).get(
+            self.process_id, 0)
+        return self.fence_epoch
 
     @property
     def rejoining(self) -> bool:
@@ -227,6 +317,17 @@ class Heartbeat:
         every host's windowed step p50 without any new transport."""
         if self._faults is not None:
             self._faults.perturb("cluster.heartbeat")
+        required = read_fences(self.directory).get(self.process_id, 0)
+        if required > self.fence_epoch:
+            # declared dead since this instance adopted its token: reject
+            # the write (the survivor plan has already moved on). The
+            # check is advisory against a racing bump — read_all()'s
+            # fence filter catches a row that slips through.
+            self._metrics.inc(tnames.CLUSTER_FENCE_REJECTS)
+            raise FencedOut(
+                f"process {self.process_id} beat with fence epoch "
+                f"{self.fence_epoch} < required {required} (declared "
+                f"dead); adopt_fence() to rejoin as a new incarnation")
         tmp = f"{self.path}.{os.getpid()}.tmp"
         row = {"process_id": self.process_id, "epoch": int(epoch),
                # wall_now(): beats from THIS process advance monotonically,
@@ -234,7 +335,8 @@ class Heartbeat:
                # its own prior beat jump forward/backward across an NTP
                # step. Cross-process comparisons stay approximate — each
                # process anchors its own wall clock at start
-               "time": wall_now()}
+               "time": wall_now(),
+               "fence": self.fence_epoch}
         if stats:
             row["stats"] = dict(stats)
         with open(tmp, "w") as f:
@@ -252,24 +354,47 @@ class Heartbeat:
         except (OSError, ValueError):
             return None
 
-    def read_all(self) -> list:
+    def read_all(self, max_age_s: Optional[float] = None) -> list:
         """Every process's last heartbeat in this directory, ordered by
         filename (deterministic); unreadable/torn files are skipped. The
-        straggler detector's fleet view."""
+        straggler detector's fleet view.
+
+        Every row is annotated with `age_s` — seconds since its file's
+        mtime, measured entirely on THIS observer's side (the write
+        node's wall clock never enters the comparison). With `max_age_s`
+        rows older than that are dropped: a crashed host's last row would
+        otherwise return forever, and its frozen-but-plausible stats
+        would keep passing the straggler check (the silent-never-flagged
+        bug). Rows carrying a stale fence token (a zombie write that
+        raced its death verdict) are dropped unconditionally."""
         try:
             names = sorted(os.listdir(self.directory))
         except OSError:
             return []
+        fences = read_fences(self.directory)
         rows = []
         for fname in names:
             if not (fname.startswith("heartbeat_")
                     and fname.endswith(".json")):
                 continue
+            path = os.path.join(self.directory, fname)
             try:
-                with open(os.path.join(self.directory, fname)) as f:
-                    rows.append(json.load(f))
+                with open(path) as f:
+                    row = json.load(f)
+                age = max(wall_now() - os.stat(path).st_mtime, 0.0)
             except (OSError, ValueError):
                 continue
+            try:
+                pid = int(row.get("process_id"))
+                fence = int(row.get("fence", 0))
+            except (TypeError, ValueError):
+                pid, fence = None, 0
+            if pid is not None and fence < fences.get(pid, 0):
+                continue   # fenced-out incarnation's row: never surfaces
+            if max_age_s is not None and age > max_age_s:
+                continue
+            row["age_s"] = age
+            rows.append(row)
         return rows
 
     def clear(self) -> None:

@@ -2,6 +2,7 @@
 breaking, recovery metrics, and a deterministic fault-injection harness
 (reference analog: FaultToleranceUtils + the scenario-level fault tests of
 HTTPv2Suite, unified and made seed-reproducible). See docs/reliability.md."""
+from .elastic import ElasticPlan, FleetCheckpoint, HostLeases, leader
 from .faults import (FAULTS_ENV, Fault, FaultInjector, InjectedCrash,
                      InjectedFault)
 from .metrics import Counter, Histogram, MetricsRegistry, reliability_metrics
@@ -16,4 +17,5 @@ __all__ = ["RetryPolicy", "RetryBudget", "Attempt", "CircuitBreaker",
            "FAULTS_ENV",
            "MetricsRegistry", "Counter", "Histogram", "reliability_metrics",
            "TrainingSupervisor", "AsyncCheckpointWriter", "Preempted",
-           "StepTimeout"]
+           "StepTimeout",
+           "HostLeases", "FleetCheckpoint", "ElasticPlan", "leader"]

@@ -252,7 +252,7 @@ class TrainingSupervisor:
                  metrics=None, faults: Optional[FaultInjector] = None,
                  step_clock=None, straggler=None,
                  straggler_threshold: float = 1.5,
-                 chunk_planner=None):
+                 chunk_planner=None, host_leases=None, elastic=None):
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self.checkpoint_every = max(int(checkpoint_every), 0)  # 0 = final only
@@ -289,6 +289,12 @@ class TrainingSupervisor:
         # chunks to healthy peers; detection stays pure observability
         # when no planner is handed in
         self.chunk_planner = chunk_planner
+        # lease-based liveness (reliability/elastic.py): the beat drives
+        # the observer-local death check, and a verdict actuates the
+        # elastic shrink (or, without an ElasticPlan, just drains the
+        # dead hosts' chunks off the plan)
+        self.host_leases = host_leases
+        self.elastic = elastic
         self.resumed_step: Optional[int] = None
         self._resumed_results: list = []
         self._last: Optional[tuple] = None   # (step, payload, results) rewind
@@ -532,6 +538,25 @@ class TrainingSupervisor:
                     self.chunk_planner.reassign(flagged)
                 except Exception as e:  # noqa: BLE001
                     logger.warning("chunk reassignment failed (%s: %s)",
+                                   type(e).__name__, e)
+        # getattr: tests drive _beat on partially-constructed supervisors
+        # (TrainingSupervisor.__new__) that predate the elastic attrs.
+        leases = getattr(self, "host_leases", None)
+        if step is not None and leases is not None:
+            dead = leases.check()              # never raises (liveness)
+            if dead:
+                # actuation, ordered AFTER the train.host.dead verdict the
+                # check just journaled: shrink the plan over the survivors
+                # (full elastic path) or at least drain the dead hosts'
+                # chunks. Failure here must not kill the surviving loop.
+                try:
+                    elastic = getattr(self, "elastic", None)
+                    if elastic is not None:
+                        elastic.shrink(dead)
+                    elif self.chunk_planner is not None:
+                        self.chunk_planner.remove_hosts(dead)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("elastic shrink failed (%s: %s)",
                                    type(e).__name__, e)
 
     def _mark(self, step: int, results: list, write: bool) -> None:

@@ -186,6 +186,31 @@ def _fleet_records(rec: dict) -> list:
     return out
 
 
+# fields of the BENCH_MODE=elastic headline record (kill-one-host run)
+# that gate as first-class LOWER-IS-BETTER metrics: how long the
+# survivors take to resume after the death verdict, and the fraction of
+# finished boosting work the committed fleet manifest failed to preserve
+_ELASTIC_METRIC = "elastic_detect_s"
+_ELASTIC_LOWER_FIELDS = ("resume_s", "lost_work_fraction")
+
+
+def _elastic_records(rec: dict) -> list:
+    """Derived gate records from one elastic-bench headline record (born
+    ``lower_better``); the parent's backend annotation rides along."""
+    if rec.get("metric") != _ELASTIC_METRIC:
+        return []
+    out = []
+    for field in _ELASTIC_LOWER_FIELDS:
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            d = {"metric": f"elastic.{field}", "value": float(v),
+                 "lower_better": True}
+            if rec.get("backend") is not None:
+                d["backend"] = rec["backend"]
+            out.append(d)
+    return out
+
+
 # fields of the BENCH_MODE=online headline that gate as first-class
 # metrics: partial_fit throughput (higher better) and the self-healing
 # window + zero-drop acceptance (born lower-is-better)
@@ -217,7 +242,8 @@ def _online_records(rec: dict) -> list:
 def _with_derived(records: list) -> list:
     return records + [d for r in records
                       for d in (_gbdt_records(r) + _fleet_records(r)
-                                + _online_records(r))]
+                                + _online_records(r)
+                                + _elastic_records(r))]
 
 
 def _records_from_text(text: str) -> list:

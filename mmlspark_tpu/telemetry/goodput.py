@@ -375,10 +375,16 @@ class StragglerDetector:
 
     def __init__(self, heartbeat, threshold: float = 1.5,
                  min_steps: int = 4, registry=None, tracer=None,
-                 profile_on_flag: bool = True):
+                 profile_on_flag: bool = True,
+                 max_age_s: Optional[float] = 30.0):
         self.heartbeat = heartbeat
         self.threshold = float(threshold)
         self.min_steps = max(int(min_steps), 1)
+        # a crashed host's LAST row is frozen-but-plausible: without an
+        # age cut the detector would evaluate it forever and never flag
+        # anything (liveness is HostLeases' job — here stale rows just
+        # leave the straggler math). None disables the filter.
+        self.max_age_s = max_age_s
         self._metrics = registry if registry is not None \
             else reliability_metrics
         self._tracer = tracer
@@ -395,7 +401,7 @@ class StragglerDetector:
         TRANSITION (not every pass) and keeps the `train.stragglers`
         gauge current. Never raises — detection is observability."""
         try:
-            rows = self.heartbeat.read_all()
+            rows = self.heartbeat.read_all(max_age_s=self.max_age_s)
         except Exception:  # noqa: BLE001 - a torn beat loses one pass
             return []
         p50s = []

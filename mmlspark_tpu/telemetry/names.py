@@ -55,6 +55,12 @@ TRAIN_PREEMPT_SIGNALS = "train.preempt_signals"
 CLUSTER_REJOINS = "cluster.rejoins"
 CLUSTER_HEARTBEAT_ERRORS = "cluster.heartbeat_errors"
 CLUSTER_RENDEZVOUS_RETRIES = "cluster.rendezvous_retries"
+CLUSTER_FENCE_REJECTS = "cluster.fence_rejects"
+CLUSTER_HEARTBEAT_TMP_SWEPT = "cluster.heartbeat_tmp_swept"
+ELASTIC_MANIFEST_COMMITS = "elastic.manifest.commits"
+ELASTIC_MANIFEST_REJECTED = "elastic.manifest.rejected"
+ELASTIC_SHRINKS = "elastic.shrinks"
+ELASTIC_RESUMES = "elastic.resumes"
 REGISTRY_REPORT_RETRIES = "registry.report_retries"
 HTTP_RETRIES = "http.retries"
 RETRY_RETRIES = "retry.retries"
@@ -142,6 +148,23 @@ COUNTERS = {
                               "never fatal)",
     CLUSTER_RENDEZVOUS_RETRIES: "jax.distributed rendezvous connection "
                                 "retries",
+    CLUSTER_FENCE_REJECTS: "heartbeat writes rejected by the epoch fence "
+                           "(a zombie host beating after its death "
+                           "verdict; the row is never written)",
+    CLUSTER_HEARTBEAT_TMP_SWEPT: "stale heartbeat .tmp files (a crash "
+                                 "between tmp-write and os.replace) swept "
+                                 "at Heartbeat startup",
+    ELASTIC_MANIFEST_COMMITS: "fleet checkpoint manifests committed by "
+                              "the leader (every member shard landed and "
+                              "digest-recorded)",
+    ELASTIC_MANIFEST_REJECTED: "fleet manifests refused on restore "
+                               "(torn JSON, missing member shard, or "
+                               "member digest mismatch) — restore falls "
+                               "back to the last fully-committed step",
+    ELASTIC_SHRINKS: "shrink plans derived after a death verdict "
+                     "(survivor set + chunk restage computed)",
+    ELASTIC_RESUMES: "shrink-resumes taken from a committed fleet "
+                     "manifest",
     REGISTRY_REPORT_RETRIES: "worker->registry registration retries",
     HTTP_RETRIES: "HTTP handler retry attempts (io/http.py)",
     RETRY_RETRIES: "generic utils.retry attempts",
@@ -267,6 +290,8 @@ CANARY_DRIFT_DELTA = "canary.drift.delta"
 CONTROL_ROLLOUT_FRACTION = "control.rollout.fraction"
 DATA_OOCORE_RESIDENT_BYTES = "data.oocore.resident_bytes"
 DATA_OOCORE_CURSOR = "data.oocore.cursor"
+CLUSTER_HOSTS_LIVE = "cluster.hosts.live"
+CLUSTER_HOSTS_DEAD = "cluster.hosts.dead"
 
 GAUGES = {
     ANALYSIS_SEMANTIC_CONTRACTS: "hot-path contracts analyzed by the last "
@@ -321,6 +346,12 @@ GAUGES = {
     DATA_OOCORE_CURSOR: "chunks durably binned into the out-of-core "
                         "spill cache so far (the resume cursor a killed "
                         "staging pass restarts from)",
+    CLUSTER_HOSTS_LIVE: "hosts currently holding a live lease (beat "
+                        "observed within lease_timeout_s of the "
+                        "observer's monotonic clock)",
+    CLUSTER_HOSTS_DEAD: "hosts declared dead by lease expiry (fenced "
+                        "out; stays counted until a fresh observer "
+                        "starts)",
     "control.router.weight.{target}": "weighted-router relative weight "
                                       "per target (host:port), 1..100 — "
                                       "scaled from scraped queue depth "
@@ -433,6 +464,9 @@ TRAIN_RESTART_EVENT = "train.restart"
 TRAIN_PREEMPTED_EVENT = "train.preempted"
 TRAIN_STRAGGLER_EVENT = "train.straggler"
 TRAIN_CHUNK_REASSIGN_EVENT = "train.chunk.reassign"
+TRAIN_HOST_DEAD_EVENT = "train.host.dead"
+ELASTIC_PLAN_EVENT = "elastic.plan"
+ELASTIC_RESUME_EVENT = "elastic.resume"
 TELEMETRY_BUNDLE_EVENT = "telemetry.bundle"
 TELEMETRY_PROFILE_EVENT = "telemetry.profile"
 TELEMETRY_WATCH_TRIP_EVENT = "telemetry.watch.trip"
@@ -454,6 +488,16 @@ EVENTS = {
     TRAIN_STRAGGLER_EVENT: "a host's windowed step p50 deviated beyond "
                            "the straggler threshold (host, p50, fleet "
                            "median attrs)",
+    TRAIN_HOST_DEAD_EVENT: "a host's lease aged past lease_timeout_s of "
+                           "observer-local clock — death verdict "
+                           "TRANSITION (host, age_s attrs); the fence "
+                           "bump rides the same transition",
+    ELASTIC_PLAN_EVENT: "survivor-side shrink plan derived after a death "
+                        "verdict (dead, survivors, restaged-chunk "
+                        "attrs) — ordered after train.host.dead",
+    ELASTIC_RESUME_EVENT: "training resumed from the committed fleet "
+                          "manifest on the shrunk host set (step, "
+                          "survivors attrs) — ordered after elastic.plan",
     TRAIN_CHUNK_REASSIGN_EVENT: "ChunkPlanner drained a flagged host's "
                                 "pending chunks to healthy hosts "
                                 "(from_host, to_hosts, chunks attrs) — "
@@ -518,6 +562,17 @@ FAULT_SITES = {
     "train.ckpt.write": "checkpoint write path (sync and async)",
     "train.ckpt.read": "checkpoint restore path",
     "cluster.heartbeat": "Heartbeat.beat() before the atomic write",
+    "cluster.lease.expire": "HostLeases.check(), fired once per "
+                            "(round, host) in sorted host order (kind "
+                            "`expire` forces a false-positive death "
+                            "verdict on that host — fencing then "
+                            "rejects its next beat exactly once; kind "
+                            "`error` skips the whole check round)",
+    "elastic.commit": "FleetCheckpoint.commit between the manifest "
+                      "tmp-write and its os.replace (kind `crash` "
+                      "models the leader dying mid-commit — no "
+                      "manifest lands, the next leader re-commits; a "
+                      "torn manifest is never restored)",
     "data.worker.chunk{index}": "ingest pool, fired before chunk i's "
                                 "transform",
     "data.oocore.stage{index}": "out-of-core stager, fired before chunk "

@@ -1,0 +1,624 @@
+"""Elastic multi-host training (ISSUE 19): lease-based liveness with
+epoch fencing, two-phase-commit fleet checkpoints, and shrink-resume.
+
+The acceptance invariant mirrors test_oocore.py: a host death mid-fit is
+pure control-plane — the model that comes out of the survivors' resumed
+fit is BIT-identical (`np.array_equal` on every Booster array) to a
+fresh surviving-host-set fit started from the committed cursor. Liveness
+itself runs on an injectable observer-local clock, so every tier-1 test
+here advances time explicitly instead of sleeping.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.data import ChunkPlanner, ChunkStager, OocoreOptions
+from mmlspark_tpu.models.gbdt.boosting import BoostParams
+from mmlspark_tpu.ops import binning
+from mmlspark_tpu.parallel.cluster import (FencedOut, Heartbeat,
+                                           read_fences)
+from mmlspark_tpu.reliability import (ElasticPlan, FleetCheckpoint,
+                                      HostLeases, leader)
+from mmlspark_tpu.reliability.faults import FaultInjector, InjectedCrash
+from mmlspark_tpu.reliability.metrics import MetricsRegistry
+from mmlspark_tpu.telemetry import names as tnames
+from mmlspark_tpu.telemetry.lineage import RunLedger
+from mmlspark_tpu.telemetry.spans import Tracer
+
+
+class _Clock:
+    """Injectable observer-local clock: tests advance it explicitly."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += float(s)
+
+
+def _dataset(n=1536, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    y = (x @ w + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return x, y
+
+
+def _same_booster(a, b):
+    ba, base_a, _ = a
+    bb, base_b, _ = b
+    assert base_a == base_b
+    for field in ba._fields:
+        va, vb = getattr(ba, field), getattr(bb, field)
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), field
+
+
+def _params(**kw):
+    base = dict(objective="binary", num_iterations=6, num_leaves=15,
+                max_depth=4, max_bin=31, min_data_in_leaf=5)
+    base.update(kw)
+    return BoostParams(**base)
+
+
+# ------------------------------------------------------------------ leases
+def test_lease_expiry_declares_dead_once_with_gauges(tmp_path):
+    """A host whose beat content stops changing for lease_timeout_s of
+    OBSERVER clock is declared dead exactly once: `train.host.dead` on
+    the transition, `cluster.hosts.{live,dead}` gauges current, and the
+    verdict measured without any wall-clock sleep (injected clock)."""
+    hb0 = Heartbeat(str(tmp_path), process_id=0)
+    hb1 = Heartbeat(str(tmp_path), process_id=1)
+    hb0.beat(1)
+    hb1.beat(1)
+    clock = _Clock()
+    reg = MetricsRegistry()
+    tracer = Tracer(sample=1.0)
+    ledger = RunLedger(str(tmp_path / "ledger.jsonl"))
+    leases = HostLeases(hb0, lease_timeout_s=5.0, clock=clock,
+                        faults=None, metrics=reg, tracer=tracer,
+                        ledger=ledger)
+    assert leases.check() == []            # both leases fresh
+    clock.advance(3.0)
+    hb1.beat(2)                            # any beat renews host 1's lease
+    assert leases.check() == []
+    clock.advance(4.0)                     # host 1 now silent for 4.0 < 5.0
+    hb0.beat(2)                            # observer keeps itself fresh
+    assert leases.check() == []
+    clock.advance(2.0)                     # silent for 6.0 > 5.0: verdict
+    hb0.beat(3)
+    assert leases.check() == [1]
+    assert leases.check() == []            # transition fires once
+    assert leases.dead == [1] and leases.live == [0]
+    assert reg.peek_gauge(tnames.CLUSTER_HOSTS_LIVE) == 1.0
+    assert reg.peek_gauge(tnames.CLUSTER_HOSTS_DEAD) == 1.0
+    deaths = tracer.finished(tnames.TRAIN_HOST_DEAD_EVENT)
+    assert len(deaths) == 1 and deaths[0]["attrs"]["host"] == 1
+    rows = [r for r in ledger.records()
+            if r.get("event") == tnames.TRAIN_HOST_DEAD_EVENT]
+    assert len(rows) == 1 and rows[0]["host"] == 1
+
+
+def test_zombie_beat_fenced_out_and_fresh_incarnation_rejoins(tmp_path):
+    """The death verdict bumps the shared fence, so the dead incarnation's
+    next beat raises FencedOut (row NOT written, reject counted) — while
+    a genuinely restarted process adopts the bumped epoch at construction
+    and beats normally."""
+    reg = MetricsRegistry()
+    hb0 = Heartbeat(str(tmp_path), process_id=0)
+    hb1 = Heartbeat(str(tmp_path), process_id=1, metrics=reg)
+    hb0.beat(1)
+    hb1.beat(1)
+    clock = _Clock()
+    leases = HostLeases(hb0, lease_timeout_s=5.0, clock=clock, faults=None,
+                        metrics=MetricsRegistry())
+    leases.check()
+    clock.advance(6.0)
+    hb0.beat(2)
+    assert leases.check() == [1]
+    assert read_fences(str(tmp_path)) == {1: 1}
+    before = hb0.read(1)
+    with pytest.raises(FencedOut):
+        hb1.beat(7)                         # zombie: stale token
+    assert reg.get(tnames.CLUSTER_FENCE_REJECTS) == 1
+    assert hb0.read(1) == before            # the row was never written
+    # a row that raced the bump onto disk is still filtered by readers
+    torn = dict(before, epoch=9, fence=0)
+    with open(hb1.path, "w") as f:
+        json.dump(torn, f)
+    assert all(int(r["process_id"]) != 1 for r in hb0.read_all())
+    # fresh incarnation (real restart): adopts fence epoch 1 and rejoins
+    hb1b = Heartbeat(str(tmp_path), process_id=1)
+    assert hb1b.fence_epoch == 1
+    hb1b.beat(8)
+    assert any(int(r["process_id"]) == 1 and r["epoch"] == 8
+               for r in hb0.read_all())
+
+
+def test_read_all_age_annotation_and_stale_filter(tmp_path):
+    """Every read_all row carries observer-side `age_s`; with max_age_s a
+    crashed host's frozen row drops out instead of returning forever."""
+    hb0 = Heartbeat(str(tmp_path), process_id=0)
+    hb1 = Heartbeat(str(tmp_path), process_id=1)
+    hb0.beat(1)
+    hb1.beat(1)
+    rows = hb0.read_all()
+    assert len(rows) == 2
+    assert all(r["age_s"] >= 0.0 for r in rows)
+    assert all(r["age_s"] < 60.0 for r in rows)
+    old = time.time() - 120.0
+    os.utime(hb1.path, (old, old))          # host 1 froze two minutes ago
+    kept = hb0.read_all(max_age_s=60.0)
+    assert [int(r["process_id"]) for r in kept] == [0]
+    allrows = hb0.read_all()                # no cut: still annotated
+    aged = {int(r["process_id"]): r["age_s"] for r in allrows}
+    assert len(allrows) == 2 and aged[1] > 100.0
+
+
+def test_straggler_detector_skips_frozen_stats_regression(tmp_path):
+    """The silent-never-flagged bug: a dead host's last stats are frozen
+    but plausible, and without the age cut they keep riding the straggler
+    math. With max_age_s the stale row leaves the check (liveness is
+    HostLeases' job); with max_age_s=None the old behavior remains."""
+    from mmlspark_tpu.telemetry.goodput import StragglerDetector
+
+    hbs = [Heartbeat(str(tmp_path), process_id=i) for i in range(3)]
+    for i, hb in enumerate(hbs):
+        p50 = 9.0 if i == 2 else 2.0
+        hb.beat(1, stats={"step_p50_ms": p50, "steps": 8, "goodput": 1.0})
+    old = time.time() - 120.0
+    os.utime(hbs[2].path, (old, old))       # the slow host actually DIED
+    det = StragglerDetector(hbs[0], threshold=1.5, max_age_s=60.0,
+                            registry=MetricsRegistry(),
+                            tracer=Tracer(sample=1.0),
+                            profile_on_flag=False)
+    assert det.check() == []                # frozen stats left the math
+    legacy = StragglerDetector(hbs[0], threshold=1.5, max_age_s=None,
+                               registry=MetricsRegistry(),
+                               tracer=Tracer(sample=1.0),
+                               profile_on_flag=False)
+    flagged = legacy.check()                # unfiltered: still evaluated
+    assert [f["process_id"] for f in flagged] == [2]
+
+
+def test_heartbeat_init_sweeps_leaked_beat_tmps(tmp_path):
+    """A crash between the beat tmp-write and os.replace leaks
+    heartbeat_N.json.<pid>.tmp forever; __init__ sweeps our own tmps
+    unconditionally and other hosts' only once stale (may be mid-write)."""
+    own_tmp = tmp_path / "heartbeat_0.json.12345.tmp"
+    stale_tmp = tmp_path / "heartbeat_1.json.777.tmp"
+    fresh_tmp = tmp_path / "heartbeat_2.json.888.tmp"
+    for p in (own_tmp, stale_tmp, fresh_tmp):
+        p.write_text("{}")
+    old = time.time() - 300.0
+    os.utime(stale_tmp, (old, old))
+    reg = MetricsRegistry()
+    Heartbeat(str(tmp_path), process_id=0, metrics=reg)
+    assert not own_tmp.exists()             # ours: no live writer possible
+    assert not stale_tmp.exists()           # theirs, 5 min old: leaked
+    assert fresh_tmp.exists()               # theirs, fresh: maybe mid-write
+    assert reg.get(tnames.CLUSTER_HEARTBEAT_TMP_SWEPT) == 2
+
+
+# ----------------------------------------------------------- planner shrink
+def test_planner_remove_hosts_drains_and_shrinks_rotation():
+    """remove_hosts drains the dead hosts' pending chunks (done chunks
+    never move) and removes them from the rotation for good — a later
+    reassign can never route work back to a dead host."""
+    planner = ChunkPlanner(9, hosts=[0, 1, 2], faults=None,
+                           tracer=Tracer(sample=1.0))
+    done = planner.assigned(2)[0]
+    planner.mark_done(done)
+    moved = planner.remove_hosts([2])
+    assert moved and all(frm == 2 for frm, _ in moved.values())
+    assert done not in moved                # staged chunk stays put
+    assert planner.hosts == [0, 1]
+    assert planner.pending(2) == []
+    later = planner.reassign([1])           # next straggler round
+    assert later and all(to == 0 for _, to in later.values())
+    assert planner.remove_hosts([5]) == {}  # unknown host: no-op
+    assert planner.remove_hosts([0, 1]) == {}   # empty fleet is not a plan
+    assert planner.hosts == [0, 1]
+
+
+# ------------------------------------------------------- fleet checkpoints
+def _shard_payload(step, pid=0):
+    return {"w": np.arange(4, dtype=np.float32) + step, "step": int(step),
+            "host": int(pid)}
+
+
+def test_fleet_two_phase_commit_leader_and_reelection(tmp_path):
+    """Phase 2 refuses until every live member's shard landed, only the
+    leader (lowest live pid) may write, and leader() re-elects over the
+    survivor set after a death."""
+    d = str(tmp_path)
+    fleets = {pid: FleetCheckpoint(d, pid, faults=None) for pid in (0, 1, 2)}
+    assert leader([0, 1, 2]) == 0 and leader([1, 2]) == 1
+    fleets[0].save_shard(2, _shard_payload(2, 0))
+    assert fleets[0].commit(2, [0, 1, 2]) is False    # members missing
+    fleets[1].save_shard(2, _shard_payload(2, 1))
+    fleets[2].save_shard(2, _shard_payload(2, 2))
+    assert fleets[1].commit(2, [0, 1, 2]) is False    # not the leader
+    assert fleets[0].commit(2, [0, 1, 2],
+                            extra={"oocore_cursor": 7}) is True
+    step, manifest = fleets[2].latest_committed()
+    assert step == 2
+    assert sorted(manifest["hosts"]) == ["0", "1", "2"]
+    assert manifest["leader"] == 0 and manifest["oocore_cursor"] == 7
+    rstep, rman, payload = fleets[2].restore()
+    assert rstep == 2 and rman == manifest
+    assert np.array_equal(payload["w"], _shard_payload(2, 2)["w"])
+    assert payload["host"] == 2
+    # host 0 dies; the re-elected leader commits the next fleet step over
+    # the survivors only
+    for pid in (1, 2):
+        fleets[pid].save_shard(4, _shard_payload(4, pid))
+    assert fleets[2].commit(4, [1, 2]) is False
+    assert fleets[1].commit(4, [1, 2]) is True
+    step, manifest = fleets[1].latest_committed()
+    assert step == 4 and sorted(manifest["hosts"]) == ["1", "2"]
+    assert manifest["leader"] == 1
+
+
+def test_fleet_restore_refuses_torn_and_partial_manifests(tmp_path):
+    """Restore falls back past (a) torn manifest JSON, (b) a manifest
+    naming a member whose shard is missing, and (c) a digest mismatch —
+    landing on the last FULLY-committed fleet step, counting each
+    rejection."""
+    d = str(tmp_path)
+    reg = MetricsRegistry()
+    fleets = {pid: FleetCheckpoint(d, pid, faults=None, metrics=reg)
+              for pid in (0, 1)}
+    for pid in (0, 1):
+        fleets[pid].save_shard(2, _shard_payload(2, pid))
+    assert fleets[0].commit(2, [0, 1]) is True
+    # (a) torn JSON at a higher step
+    with open(os.path.join(d, "manifest_step_6.json"), "w") as f:
+        f.write('{"step": 6, "hosts": {"0"')
+    # (b) member never landed its shard
+    fleets[0].save_shard(4, _shard_payload(4, 0))
+    with open(os.path.join(d, "manifest_step_4.json"), "w") as f:
+        json.dump({"step": 4, "leader": 0, "hosts": {
+            "0": fleets[0]._member_digests(0, 4), "1": {"meta": "ab"}}}, f)
+    # (c) digest mismatch against the on-disk shard
+    with open(os.path.join(d, "manifest_step_3.json"), "w") as f:
+        json.dump({"step": 3, "leader": 0,
+                   "hosts": {"0": {"meta": "00"}}}, f)
+    step, manifest = fleets[1].latest_committed()
+    assert step == 2 and sorted(manifest["hosts"]) == ["0", "1"]
+    assert reg.get(tnames.ELASTIC_MANIFEST_REJECTED) == 3
+    assert fleets[1].restore()[0] == 2
+
+
+# ------------------------------------------------------------------- chaos
+def test_chaos_lease_expire_false_positive_costs_one_beat(tmp_path):
+    """Seeded `cluster.lease.expire` chaos: a forced false-positive death
+    verdict fences the victim's survivor-side plan exactly once — the
+    very next incarnation step (adopt_fence) rejoins and beats fine, so
+    the fit completes. Kind `error` skips the whole check round."""
+    hb0 = Heartbeat(str(tmp_path), process_id=0)
+    hb1 = Heartbeat(str(tmp_path), process_id=1)
+    hb0.beat(1)
+    hb1.beat(1)
+    # check() perturbs the site once per (round, host) in sorted host
+    # order: call 0 is host 0 (the observer itself, verdict-exempt), so
+    # at=[1] lands the forced expiry on host 1
+    inj = FaultInjector(seed=5, rules=[
+        {"site": "cluster.lease.expire", "kind": "expire", "at": [1]}])
+    clock = _Clock()
+    ledger = RunLedger(str(tmp_path / "ledger.jsonl"))
+    leases = HostLeases(hb0, lease_timeout_s=1e9, clock=clock, faults=inj,
+                        metrics=MetricsRegistry(), ledger=ledger)
+    assert leases.check() == [1]            # lease nowhere near expiry
+    assert [r["host"] for r in ledger.records()
+            if r.get("event") == tnames.TRAIN_HOST_DEAD_EVENT] == [1]
+    with pytest.raises(FencedOut):
+        hb1.beat(2)                         # the one rejected beat
+    hb1.adopt_fence()
+    hb1.beat(3)                             # rejoined: fit completes
+    assert hb0.read(1)["epoch"] == 3
+    # kind `error` at the same site loses one whole check round, never
+    # corrupts the lease table
+    inj2 = FaultInjector(seed=5, rules=[
+        {"site": "cluster.lease.expire", "kind": "error", "at": [0]}])
+    leases2 = HostLeases(hb0, lease_timeout_s=1e9, clock=_Clock(),
+                         faults=inj2, metrics=MetricsRegistry())
+    assert leases2.check() == []
+    assert leases2.dead == []
+
+
+def test_chaos_commit_crash_next_leader_recommits(tmp_path):
+    """Seeded `elastic.commit` chaos: the leader dies between the
+    manifest tmp-write and its os.replace — NO manifest exists (the torn
+    attempt can never be restored), and the re-elected leader simply
+    re-commits the same fleet step."""
+    d = str(tmp_path)
+    inj = FaultInjector(seed=3, rules=[
+        {"site": "elastic.commit", "kind": "crash", "at": [0]}])
+    fleets = {0: FleetCheckpoint(d, 0, faults=inj),
+              1: FleetCheckpoint(d, 1, faults=None),
+              2: FleetCheckpoint(d, 2, faults=None)}
+    for pid in (0, 1, 2):
+        fleets[pid].save_shard(2, _shard_payload(2, pid))
+    with pytest.raises(InjectedCrash):
+        fleets[0].commit(2, [0, 1, 2])      # leader killed mid-commit
+    assert fleets[1].latest_committed() is None
+    assert fleets[1].restore() is None      # nothing torn ever restored
+    assert any(n.endswith(".tmp") for n in os.listdir(d))
+    # host 0 is now dead; the next leader re-commits over the survivors
+    assert fleets[1].commit(2, [1, 2]) is True
+    step, manifest = fleets[2].latest_committed()
+    assert step == 2 and sorted(manifest["hosts"]) == ["1", "2"]
+
+
+# ------------------------------------------------------- supervisor wiring
+def test_supervisor_beat_drives_lease_check_and_shrink(tmp_path):
+    """reliability.supervisor wiring: the step beat drives
+    HostLeases.check() after the straggler block; a verdict actuates
+    ElasticPlan.shrink (or the planner drain without one) and an actuator
+    that throws must not kill the surviving training loop."""
+    hb0 = Heartbeat(str(tmp_path), process_id=0)
+    hb1 = Heartbeat(str(tmp_path), process_id=1)
+    hb1.beat(1)
+    clock = _Clock()
+    leases = HostLeases(hb0, lease_timeout_s=5.0, clock=clock, faults=None,
+                        metrics=MetricsRegistry())
+
+    shrinks = []
+
+    class Elastic:
+        def shrink(self, dead):
+            shrinks.append(list(dead))
+            raise RuntimeError("actuator broke")
+
+    class Clock:
+        def beat_stats(self):
+            return {"step_p50_ms": 2.0, "steps": 8, "goodput": 1.0}
+
+    from mmlspark_tpu.reliability import supervisor as sup
+    s = sup.TrainingSupervisor.__new__(sup.TrainingSupervisor)
+    s.heartbeat = hb0
+    s.clock = Clock()
+    s.metrics = MetricsRegistry()
+    s.straggler = None
+    s.chunk_planner = None
+    s.host_leases = leases
+    s.elastic = Elastic()
+    s._beat(1)                              # observes both hosts
+    assert shrinks == []
+    clock.advance(6.0)
+    s._beat(2)                              # renews host 0, ages host 1 out
+    assert shrinks == [[1]]                 # verdict actuated, raise absorbed
+    # without an ElasticPlan the verdict still drains the dead host's
+    # chunks off the plan
+    hb1b = Heartbeat(str(tmp_path), process_id=1)
+    hb1b.beat(2)
+    clock2 = _Clock()
+    s.host_leases = HostLeases(hb0, lease_timeout_s=5.0, clock=clock2,
+                               faults=None, metrics=MetricsRegistry())
+    s.elastic = None
+    s.chunk_planner = ChunkPlanner(6, hosts=[0, 1], faults=None,
+                                   tracer=Tracer(sample=1.0))
+    s._beat(3)
+    clock2.advance(6.0)
+    s._beat(4)
+    assert s.chunk_planner.hosts == [0]
+    assert s.chunk_planner.pending(1) == []
+
+
+# ----------------------------------------------------- acceptance (tier-1)
+def test_sigkill_one_host_shrink_resume_bit_identical(tmp_path):
+    """The ISSUE-19 acceptance, in-process with an injected observer
+    clock (a SIGKILL'd host IS a host that stops beating — the
+    multi-process variant is the `slow` smoke below):
+
+    three hosts fit out-of-core on a 6-device mesh, fleet-committing at
+    iteration 3; host 2 dies mid-staging; the survivors detect the death
+    via lease expiry (no wall sleeps), fence the zombie out, shrink the
+    chunk plan and mesh, re-stage the dead host's chunks from the shared
+    spill cache, and resume from the committed manifest. The RunLedger
+    pins `train.host.dead < elastic.plan < elastic.resume`, the resumed
+    model is bit-identical to a fresh surviving-host-set fit from the
+    committed cursor, and the shrunk mesh shows up as FRESH compile
+    records (recompiles honest, not pinned)."""
+    import jax
+    if jax.device_count() < 6:
+        pytest.skip("needs >= 6 devices")
+    from mmlspark_tpu.models.gbdt.booster import Booster
+    from mmlspark_tpu.models.gbdt.distributed import fit_booster_distributed
+    from mmlspark_tpu.parallel.mesh import data_mesh
+    from mmlspark_tpu.telemetry import perf as tperf
+
+    x, y = _dataset()                       # 1536 rows: divides 6 and 4
+    p_total = _params(num_iterations=6)
+    mapper = binning.fit_bins(x, max_bin=p_total.max_bin)
+    x_path = str(tmp_path / "x.npy")
+    np.save(x_path, x)
+    cache = str(tmp_path / "bins.npy")
+    opts = OocoreOptions(max_resident_bytes=x.nbytes // 8, cache_path=cache)
+    n_chunks = len(ChunkStager(x_path, mapper, opts, only=set()).source)
+    assert n_chunks >= 6
+
+    tracer = Tracer(sample=1.0)
+    ledger = RunLedger(str(tmp_path / "ledger.jsonl"))
+    planner = ChunkPlanner(n_chunks, hosts=[0, 1, 2], faults=None,
+                           tracer=tracer, ledger=ledger)
+    hb = {i: Heartbeat(str(tmp_path / "hb"), process_id=i)
+          for i in range(3)}
+    fleets = {i: FleetCheckpoint(str(tmp_path / "ck"), i, faults=None)
+              for i in range(3)}
+
+    def stage_host(h):
+        todo = set(planner.pending(h))
+        if todo:
+            ChunkStager(x_path, mapper, opts, only=todo).stage()
+            for i in todo:
+                planner.mark_done(i)
+
+    # hosts 0 and 1 drain their shares; host 2 stages only its first
+    # chunk before dying — its remainder must be re-staged, not lost
+    stage_host(0)
+    stage_host(1)
+    first2 = planner.pending(2)[0]
+    ChunkStager(x_path, mapper, opts, only={first2}).stage()
+    planner.mark_done(first2)
+    staged_before_death = n_chunks - len(planner.pending(2))
+
+    def _chunk_fps():
+        return {r["fingerprint"] for r in tperf.get_compile_log().records()
+                if str(r.get("label", "")).startswith("gbdt.")}
+
+    fps0 = _chunk_fps()
+    committed = {}
+
+    def ck_fn(it, booster, fit_base, final=False, margin=None,
+              rng_key=None):
+        if it != 3:
+            return
+        payload = {"booster": booster.save_model_string(),
+                   "iteration": int(it), "base": float(fit_base),
+                   "margin": np.asarray(margin, np.float32),
+                   "rng_key": np.asarray(rng_key)}
+        committed.update(payload)
+        # trees are replicated, so every host's shard carries the same
+        # state; the leader of the full fleet commits the manifest with
+        # the durable staging cursor riding along
+        for pid in (0, 1, 2):
+            fleets[pid].save_shard(it, payload)
+        assert fleets[0].commit(
+            it, [0, 1, 2],
+            extra={"oocore_cursor": staged_before_death}) is True
+
+    # phase A: "the killed fleet" — the full 3-host fit on the 6-device
+    # mesh runs far enough to land the step-3 fleet commit
+    fit_booster_distributed(x, y, p_total, mesh=data_mesh(6),
+                            checkpoint_fn=ck_fn, checkpoint_interval=3)
+    assert committed and fleets[1].latest_committed()[0] == 3
+    fps_a = _chunk_fps()
+    assert fps_a - fps0                     # the 6-device mesh compiled
+
+    # host 2 stops beating; the survivors' observer-local leases age it
+    # out with NO wall-clock sleep anywhere
+    clock = _Clock()
+    for i in range(3):
+        hb[i].beat(1)
+    leases = HostLeases(hb[0], lease_timeout_s=10.0, clock=clock,
+                        faults=None, metrics=MetricsRegistry(),
+                        tracer=tracer, ledger=ledger)
+    assert leases.check() == []
+    clock.advance(11.0)
+    hb[0].beat(2)
+    hb[1].beat(2)
+    assert leases.check() == [2]            # death detected via lease expiry
+    reg2 = MetricsRegistry()
+    hb2_zombie = Heartbeat(str(tmp_path / "hb"), process_id=2, metrics=reg2)
+    hb2_zombie.fence_epoch = 0              # the pre-verdict incarnation
+    with pytest.raises(FencedOut):
+        hb2_zombie.beat(3)                  # provably fenced out
+    assert reg2.get(tnames.CLUSTER_FENCE_REJECTS) == 1
+
+    # shrink: re-derive the plan + mesh over the survivors and re-stage
+    # the dead host's unfinished chunks from the shared spill cache
+    elastic = ElasticPlan(planner=planner, fleet=fleets[1],
+                          devices_per_host=2, metrics=MetricsRegistry(),
+                          tracer=tracer, ledger=ledger)
+    plan = elastic.shrink([2])
+    assert plan["survivors"] == [0, 1] and plan["step"] == 3
+    assert plan["restaged"]                 # host 2 really had work pending
+    stage_host(0)
+    stage_host(1)
+    assert all(not planner.pending(h) for h in (0, 1))
+    assembled = np.asarray(np.lib.format.open_memmap(cache, mode="r"))
+    assert np.array_equal(assembled, binning.apply_bins(mapper, x))
+
+    # resume from the committed manifest on the shrunk mesh
+    step, manifest, payload = elastic.resume()
+    assert step == 3 and manifest["oocore_cursor"] == staged_before_death
+    mesh4 = elastic.mesh()
+    assert mesh4.shape["data"] == 4
+    p_rem = _params(num_iterations=3)
+
+    def resume_fit(src):
+        return fit_booster_distributed(
+            x, y, p_rem, mesh=mesh4,
+            init_booster=Booster.load_model_string(str(src["booster"])),
+            init_base=float(src["base"]),
+            init_margin=np.asarray(src["margin"], np.float32),
+            init_rng_key=np.asarray(src["rng_key"]),
+            iter_offset=int(src["iteration"]))
+
+    resumed = resume_fit(payload)
+    # the manifest round-trips the committed cursor bit-exactly: a fresh
+    # surviving-host-set fit from the in-memory committed state is the
+    # SAME model
+    _same_booster(resumed, resume_fit(committed))
+    assert resumed[0].n_trees == 6          # 3 committed + 3 resumed trees
+    fps_b = _chunk_fps()
+    assert fps_b - fps_a                    # shrunk mesh: fresh compiles
+
+    # the ledger pins the causal order by line position alone
+    events = [r["event"] for r in ledger.records()
+              if r.get("event") in (tnames.TRAIN_HOST_DEAD_EVENT,
+                                    tnames.ELASTIC_PLAN_EVENT,
+                                    tnames.ELASTIC_RESUME_EVENT)]
+    assert events == [tnames.TRAIN_HOST_DEAD_EVENT,
+                      tnames.ELASTIC_PLAN_EVENT,
+                      tnames.ELASTIC_RESUME_EVENT]
+
+
+# ------------------------------------------------------- multi-process slow
+@pytest.mark.slow
+def test_sigkill_subprocess_detected_by_leases(tmp_path):
+    """The real thing, excluded from tier-1 by the `slow` mark: two child
+    processes beat into a shared directory on their own wall clocks; one
+    is SIGKILL'd and the observer's monotonic leases age it out within
+    the lease budget while the survivor stays live."""
+    child = textwrap.dedent("""
+        import sys, time
+        from mmlspark_tpu.parallel.cluster import Heartbeat
+        hb = Heartbeat(sys.argv[1], process_id=int(sys.argv[2]))
+        for i in range(600):
+            hb.beat(i)
+            time.sleep(0.05)
+    """)
+    d = str(tmp_path / "hb")
+    procs = [subprocess.Popen([sys.executable, "-c", child, d, str(pid)],
+                              env=dict(os.environ, JAX_PLATFORMS="cpu"))
+             for pid in (1, 2)]
+    try:
+        hb0 = Heartbeat(d, process_id=0)
+        leases = HostLeases(hb0, lease_timeout_s=1.0, faults=None,
+                            metrics=MetricsRegistry())
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            leases.check()
+            if sorted(set(leases.live) - {0}) == [1, 2]:
+                break
+            time.sleep(0.1)
+        assert sorted(set(leases.live) - {0}) == [1, 2]
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait()
+        t0 = time.monotonic()
+        dead = []
+        while time.monotonic() < t0 + 15.0:
+            dead = leases.check()
+            if dead:
+                break
+            time.sleep(0.1)
+        assert dead == [2]
+        assert time.monotonic() - t0 < 15.0
+        assert 1 in leases.live             # the survivor never flagged
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
