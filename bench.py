@@ -1017,6 +1017,105 @@ def _bench_serving():
         **out}))
 
 
+def _bench_workloads():
+    """Fleet workloads closed-loop A/B (BENCH_MODE=workloads): both
+    ISSUE-20 estimators fitted for real and served behind `serve_pipeline`
+    under the same io/loadgen harness as BENCH_MODE=serving, each measured
+    twice back-to-back:
+
+    - *_legacy_*: fast_path=False — per-row JSON dicts, per-batch Table +
+      the uncompiled model.transform (the seed jit forest walk for
+      iforest; the host affinity-gather + per-batch top_k re-upload for
+      SAR): the pre-PR baseline;
+    - headline: fast_path=True — the compiled serving plans (tree-parallel
+      host descent / ONE sharded psum matmul + on-device top_k) through
+      the bucketed zero-recompile path.
+
+    One headline record, backend-stamped; benchdiff derives
+    workloads.{iforest,sar}.req_per_sec (higher-better) and
+    workloads.{iforest,sar}.p99_ms (born lower_better) gates from it.
+    Quiet-host numbers; tests/test_workloads.py pins the invariants
+    (parity, recompiles==0, zero-drop swap)."""
+    import json as _json
+    import jax
+    from mmlspark_tpu.core import Table
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    from mmlspark_tpu.reliability.metrics import reliability_metrics
+    from mmlspark_tpu.telemetry.lineage import model_version
+    from mmlspark_tpu.workloads import IsolationForestScorer, SARServing
+
+    rng = np.random.default_rng(0)
+
+    def closed_loop(model, input_cols, output_col, body, fast_path,
+                    n_clients=8, per_client=100):
+        reliability_metrics.reset("serving.")
+        server, q = serve_pipeline(model, input_cols=input_cols,
+                                   output_col=output_col, mode="microbatch",
+                                   max_batch=256, fast_path=fast_path)
+        host, port = server._httpd.server_address[:2]
+        try:
+            res = run_load(host, port, body, n_clients=n_clients,
+                           per_client=per_client)
+            assert not res.errors, res.errors[:3]
+        finally:
+            q.stop()
+            server.stop()
+        return res
+
+    # -- IsolationForest: same rows the estimator profiles (5% shifted) ----
+    n, f = 20_000, 16
+    x = np.vstack([rng.normal(size=(n - n // 20, f)),
+                   rng.normal(4.0, 1.0, size=(n // 20, f))]).astype(
+                       np.float32)
+    if_model = IsolationForestScorer(num_estimators=64, max_samples=256,
+                                     seed=7).fit(Table({"features": x}))
+    if_body = _json.dumps({"features": [0.1] * f})
+    if_legacy = closed_loop(if_model, ["features"], "outlierScore",
+                            if_body, fast_path=False)
+    if_fast = closed_loop(if_model, ["features"], "outlierScore",
+                          if_body, fast_path=True)
+
+    # -- SAR: dense-ish catalog so the matmul is the cost ------------------
+    n_users, n_items, n_ev = 256, 128, 20_000
+    events = Table({"user": rng.integers(0, n_users, n_ev),
+                    "item": rng.integers(0, n_items, n_ev),
+                    "rating": rng.uniform(1.0, 5.0, n_ev),
+                    "timestamp": rng.integers(0, 10**6, n_ev).astype(
+                        np.float64)})
+    sar_model = SARServing(support_threshold=2,
+                           num_recommendations=10).fit(events)
+    sar_body = _json.dumps({"user": 3})
+    sar_legacy = closed_loop(sar_model, ["user"], "recommendations",
+                             sar_body, fast_path=False)
+    sar_fast = closed_loop(sar_model, ["user"], "recommendations",
+                           sar_body, fast_path=True)
+
+    print(json.dumps({
+        "metric": "workloads_req_per_sec",
+        # headline: combined compiled-path throughput; the per-workload
+        # fields below are what benchdiff actually gates on
+        "value": round(if_fast.req_per_sec + sar_fast.req_per_sec, 1),
+        "unit": "req/s",
+        "backend": jax.default_backend(),
+        "iforest_req_per_sec": round(if_fast.req_per_sec, 1),
+        "iforest_p99_ms": round(if_fast.p99_ms, 2),
+        "iforest_legacy_req_per_sec": round(if_legacy.req_per_sec, 1),
+        "iforest_legacy_p99_ms": round(if_legacy.p99_ms, 2),
+        "iforest_speedup_vs_legacy": round(
+            if_fast.req_per_sec / max(if_legacy.req_per_sec, 1e-9), 2),
+        "iforest_model": "IsolationForestScorer 64 trees, 16 features",
+        "iforest_model_version": model_version(if_model).version,
+        "sar_req_per_sec": round(sar_fast.req_per_sec, 1),
+        "sar_p99_ms": round(sar_fast.p99_ms, 2),
+        "sar_legacy_req_per_sec": round(sar_legacy.req_per_sec, 1),
+        "sar_legacy_p99_ms": round(sar_legacy.p99_ms, 2),
+        "sar_speedup_vs_legacy": round(
+            sar_fast.req_per_sec / max(sar_legacy.req_per_sec, 1e-9), 2),
+        "sar_model": "SARServing 256 users x 128 items, k=10",
+        "sar_model_version": model_version(sar_model).version}))
+
+
 def _bench_telemetry():
     """Telemetry overhead A/B (ISSUE 5 satellite): the SAME closed-loop
     serving harness as BENCH_MODE=serving (real fitted GBDT booster,
@@ -1996,6 +2095,8 @@ def main():
         return _bench_elastic()
     if mode == "serving":
         return _bench_serving()
+    if mode == "workloads":
+        return _bench_workloads()
     if mode == "ckpt":
         return _bench_ckpt()
     if mode == "telemetry":

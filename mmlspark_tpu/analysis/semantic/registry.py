@@ -27,6 +27,8 @@ ENTRYPOINTS: Tuple[Tuple[str, str], ...] = (
     ("mmlspark_tpu.models.gbdt.distributed", "gbdt_vote_distributed_contract"),
     ("mmlspark_tpu.online.learner", "online_update_contract"),
     ("mmlspark_tpu.ops.histogram", "gbdt_hist_route_contract"),
+    ("mmlspark_tpu.workloads.iforest", "iforest_score_contract"),
+    ("mmlspark_tpu.workloads.sar_serving", "sar_score_sharded_contract"),
 )
 
 

@@ -387,6 +387,34 @@ class ServingTransform:
                 return np.asarray(kernel(idx, val))[:n]
 
             return assemble, run
+        if handle.kernel is not None and getattr(handle.kernel,
+                                                 "row_ids", False):
+            # id-keyed fast path (workloads/sar_serving.py): each row is
+            # ONE scalar integer id the kernel resolves against the
+            # model's fitted tables. Rows pad to the bucket by repeating
+            # the last id — a real id, so the kernel never sees synthetic
+            # keys — and trim after. A non-integer or non-scalar id is
+            # CLIENT data -> per-row 400 at assembly.
+            kernel = handle.kernel
+            col = cols[0]
+            rows_metric = getattr(kernel, "rows_metric", None)
+            metrics = self._metrics
+
+            def assemble(rows: list) -> np.ndarray:
+                ids = np.asarray([r[col] for r in rows])
+                if ids.ndim != 1 or ids.dtype.kind not in "iu":
+                    raise ValueError(
+                        f"column {col!r} must hold scalar integer ids")
+                return ids.astype(np.int64)
+
+            def run(ids: np.ndarray) -> np.ndarray:
+                n = ids.shape[0]
+                out = np.asarray(kernel(pad_rows_to_bucket(ids, bucket)))[:n]
+                if rows_metric is not None:
+                    metrics.inc(rows_metric, n)
+                return out
+
+            return assemble, run
         if handle.kernel is not None:
             kernel = handle.kernel
             col = cols[0]
@@ -494,6 +522,9 @@ class ServingTransform:
                           "input_cols": len(self.input_cols),
                           "kind": ("sparse-kernel"
                                    if getattr(handle.kernel, "sparse_pairs",
+                                              False)
+                                   else "id-kernel"
+                                   if getattr(handle.kernel, "row_ids",
                                               False)
                                    else "host-kernel"
                                    if handle.kernel is not None

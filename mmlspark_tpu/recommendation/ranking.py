@@ -31,8 +31,8 @@ def _hits_matrix(preds, labels):
 
 
 def ranking_metrics(preds, labels, k: int) -> dict:
-    """MAP, ndcgAt, precisionAtk, recallAtK, diversityAtK, maxDiversity —
-    the reference's AdvancedRankingMetrics surface (RankingEvaluator.scala:20-45)."""
+    """MAP, ndcgAt, precisionAtk, recallAtK, diversityAtK — the reference's
+    AdvancedRankingMetrics surface (RankingEvaluator.scala:20-45)."""
     hits, sizes = _hits_matrix(preds, labels)
     n, width = hits.shape
     kk = min(k, width) if width else 0
@@ -45,7 +45,11 @@ def ranking_metrics(preds, labels, k: int) -> dict:
         ap = (prec_at_rank * hits).sum(axis=1) / np.maximum(sizes, 1)
         # NDCG@k, binary gains
         dcg = (hits[:, :kk] / np.log2(ranks[:kk] + 1)).sum(axis=1)
-        ideal_len = np.minimum(sizes, kk)
+        # ideal DCG length is min(|labels|, k) — Spark's RankingMetrics
+        # ndcgAt semantics. Clipping to the widest PREDICTION list
+        # instead would understate the ideal and inflate NDCG whenever a
+        # recommender returns fewer than k items.
+        ideal_len = np.minimum(sizes, k)
         max_len = int(ideal_len.max()) if n else 0
         igains = 1.0 / np.log2(np.arange(1, max_len + 1) + 1) if max_len else \
             np.zeros(0)

@@ -211,6 +211,37 @@ def _elastic_records(rec: dict) -> list:
     return out
 
 
+# fields of the BENCH_MODE=workloads headline (iforest + SAR closed-loop
+# serving A/B) that gate as first-class per-workload metrics: compiled-path
+# throughput (higher better) and its tail latency (born lower-is-better)
+_WORKLOADS_METRIC = "workloads_req_per_sec"
+_WORKLOADS_HIGHER_FIELDS = ("iforest_req_per_sec", "sar_req_per_sec")
+_WORKLOADS_LOWER_FIELDS = ("iforest_p99_ms", "sar_p99_ms")
+
+
+def _workloads_records(rec: dict) -> list:
+    """Derived gate records from one workloads-bench headline record —
+    ``workloads.iforest.*`` / ``workloads.sar.*`` so each workload's
+    throughput and tail gate independently of the combined headline; the
+    parent's backend annotation rides along."""
+    if rec.get("metric") != _WORKLOADS_METRIC:
+        return []
+    out = []
+    for field, lower in ([(f, False) for f in _WORKLOADS_HIGHER_FIELDS]
+                         + [(f, True) for f in _WORKLOADS_LOWER_FIELDS]):
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            workload, metric = field.split("_", 1)
+            d = {"metric": f"workloads.{workload}.{metric}",
+                 "value": float(v)}
+            if lower:
+                d["lower_better"] = True
+            if rec.get("backend") is not None:
+                d["backend"] = rec["backend"]
+            out.append(d)
+    return out
+
+
 # fields of the BENCH_MODE=online headline that gate as first-class
 # metrics: partial_fit throughput (higher better) and the self-healing
 # window + zero-drop acceptance (born lower-is-better)
@@ -243,7 +274,8 @@ def _with_derived(records: list) -> list:
     return records + [d for r in records
                       for d in (_gbdt_records(r) + _fleet_records(r)
                                 + _online_records(r)
-                                + _elastic_records(r))]
+                                + _elastic_records(r)
+                                + _workloads_records(r))]
 
 
 def _records_from_text(text: str) -> list:

@@ -105,6 +105,9 @@ CONTROL_ADMISSION_SHED = "control.admission.shed"
 CONTROL_ROUTER_UPDATES = "control.router.updates"
 CONTROL_SCALER_SPAWNS = "control.scaler.spawns"
 CONTROL_SCALER_DRAINS = "control.scaler.drains"
+WORKLOADS_IFOREST_TREES = "workloads.iforest.trees"
+WORKLOADS_SAR_RECOMMEND_ROWS = "workloads.sar.recommend.rows"
+WORKLOADS_SAR_UNKNOWN_USERS = "workloads.sar.unknown_users"
 
 COUNTERS = {
     SERVING_SHED_REQUESTS: "requests answered 503 (drain or max_queue "
@@ -254,6 +257,15 @@ COUNTERS = {
                            "fleet scaler",
     CONTROL_SCALER_DRAINS: "drain hooks fired by the occupancy-driven "
                            "fleet scaler",
+    WORKLOADS_IFOREST_TREES: "isolation trees grown (one supervisor step "
+                             "each — the resumable fit cursor's rate)",
+    WORKLOADS_SAR_RECOMMEND_ROWS: "user rows answered by the compiled "
+                                  "SAR recommend plan (served top-k "
+                                  "batches, after bucket-pad trim)",
+    WORKLOADS_SAR_UNKNOWN_USERS: "recommend requests for user ids "
+                                 "outside the fitted range (answered "
+                                 "items=-1/ratings=NaN, the cold-start "
+                                 "convention)",
     "data.pool.{mode}_maps": "WorkerPool.map_rows calls per backend "
                              "(process/thread)",
     "gbdt.hist.route.{route}": "histogram kernel-route selections "
@@ -292,6 +304,8 @@ DATA_OOCORE_RESIDENT_BYTES = "data.oocore.resident_bytes"
 DATA_OOCORE_CURSOR = "data.oocore.cursor"
 CLUSTER_HOSTS_LIVE = "cluster.hosts.live"
 CLUSTER_HOSTS_DEAD = "cluster.hosts.dead"
+WORKLOADS_IFOREST_THRESHOLD = "workloads.iforest.threshold"
+WORKLOADS_SAR_CATALOG_ITEMS = "workloads.sar.catalog.items"
 
 GAUGES = {
     ANALYSIS_SEMANTIC_CONTRACTS: "hot-path contracts analyzed by the last "
@@ -352,6 +366,12 @@ GAUGES = {
     CLUSTER_HOSTS_DEAD: "hosts declared dead by lease expiry (fenced "
                         "out; stays counted until a fresh observer "
                         "starts)",
+    WORKLOADS_IFOREST_THRESHOLD: "contamination score threshold of the "
+                                 "last fitted isolation forest (2.0 = "
+                                 "labeling disabled)",
+    WORKLOADS_SAR_CATALOG_ITEMS: "item-catalog width of the last fitted "
+                                 "SAR serving model (the sharded matmul's "
+                                 "contraction axis before mesh padding)",
     "control.router.weight.{target}": "weighted-router relative weight "
                                       "per target (host:port), 1..100 — "
                                       "scaled from scraped queue depth "
@@ -608,6 +628,11 @@ FAULT_SITES = {
                     "raise rewinds the learner to the pre-refit snapshot "
                     "and retries — counted online.refit_retries; the "
                     "incumbent keeps serving throughout)",
+    "workloads.sar.refit": "SARServing._fit, fired after the similarity "
+                           "build but before the model assembles (a "
+                           "raise aborts the candidate fit — a serving "
+                           "incumbent is untouched because install_model "
+                           "only ever sees a whole fitted model)",
 }
 
 # ------------------------------------------- benchdiff record names

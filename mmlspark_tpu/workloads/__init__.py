@@ -1,0 +1,17 @@
+"""Device-native fleet workloads (ROADMAP item 6).
+
+Anomaly detection and recommendation grown onto the full serving /
+training / observability stack: each workload here costs an estimator
+and a plan builder — the serving fast path, supervisor checkpointing,
+lineage versions, drift references, hot-swap and chaos drills are all
+inherited. See docs/workloads.md.
+"""
+from .base import attach_workload_observability
+from .iforest import IsolationForestScorer, IsolationForestScorerModel
+from .sar_serving import SARServing, SARServingModel
+
+__all__ = [
+    "attach_workload_observability",
+    "IsolationForestScorer", "IsolationForestScorerModel",
+    "SARServing", "SARServingModel",
+]

@@ -1,0 +1,329 @@
+"""Isolation forest on the fleet stack: supervised fit, compiled serving.
+
+`models/isolation_forest.py` is the seed-era port — correct, but it
+touches none of the deployment machinery. This module is the same
+algorithm grown onto the full stack (ROADMAP item 6):
+
+- **Serving**: `IsolationForestScorerModel.scoring_plan()` compiles the
+  array-heap trees into the `Booster.scoring_plan` shape — one flattened
+  `(n, T)` node matrix descended `depth` iterations with vectorized
+  gathers, no Table construction on the hot path. `_serving_kernel`
+  exposes it to `io/plan.py`, so `serve_pipeline(fast_path=True)`
+  answers with `plan.recompiles` pinned 0 across same-bucket batches.
+- **Training**: `IsolationForestScorer._fit` routes through
+  `reliability.supervisor.TrainingSupervisor` (one step per tree, the
+  four heap arrays are the checkpoint payload, the tree cursor rides
+  STEP_KEY). Every tree draws from its own `default_rng([seed, ti, ..])`
+  streams, so a killed-and-resumed fit is bit-identical to an
+  uninterrupted one regardless of which trees were replayed.
+- **Ingest**: `oocore=OocoreOptions(...)` streams the per-tree subsample
+  gather through bounded row slabs (`data.chunk.ChunkSource`) instead of
+  fancy-indexing the resident matrix per tree.
+
+Scoring parity with the seed scorer is pinned in tier-1 (allclose,
+rtol 1e-6); the `iforest.score` graftsem contract pins the device
+descent to ONE collective-free executable.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..core import Param, Table
+from ..core.params import in_range
+from ..models.isolation_forest import (IsolationForest, IsolationForestModel,
+                                       _avg_path_length, _score_forest)
+from ..reliability.metrics import reliability_metrics
+from ..telemetry import names as tnames
+from .base import attach_workload_observability
+
+
+def _grow_tree(xt: np.ndarray, rng, depth: int, n_nodes: int,
+               feats: np.ndarray):
+    """Grow ONE isolation tree over the feature-sliced subsample `xt`
+    (m_sub, d_used) with the vectorized per-level segment min/max build of
+    the seed estimator. `rng` must be fresh per call (the supervisor may
+    replay a step after an injected restart — a reused stream would grow a
+    different tree on the second attempt). Returns the four heap rows."""
+    m_sub = xt.shape[0]
+    d_used = len(feats)
+    split_feat = np.zeros(n_nodes, np.int32)
+    split_thresh = np.full(n_nodes, np.inf, np.float32)
+    is_leaf = np.ones(n_nodes, bool)
+    path_value = np.zeros(n_nodes, np.float32)
+    node = np.ones(m_sub, np.int64)  # all samples at root (heap index 1)
+    for _level in range(depth):
+        uniq = np.unique(node)
+        sizes = np.bincount(node, minlength=n_nodes)
+        active = uniq[sizes[uniq] > 1]
+        if not len(active):
+            break
+        f_choice = rng.integers(0, d_used, size=n_nodes)
+        fcol = xt[np.arange(m_sub), f_choice[node]]
+        mins = np.full(n_nodes, np.inf, np.float32)
+        maxs = np.full(n_nodes, -np.inf, np.float32)
+        np.minimum.at(mins, node, fcol)
+        np.maximum.at(maxs, node, fcol)
+        u = rng.random(n_nodes).astype(np.float32)
+        with np.errstate(invalid="ignore"):  # empty nodes: inf-(-inf)
+            thresh = np.where(maxs > mins, mins + u * (maxs - mins), np.inf)
+        splittable = np.zeros(n_nodes, bool)
+        splittable[active] = maxs[active] > mins[active]
+        is_leaf[splittable] = False
+        split_feat = np.where(splittable, feats[f_choice],
+                              split_feat).astype(np.int32)
+        split_thresh = np.where(splittable, thresh, split_thresh)
+        go = splittable[node]
+        node = np.where(go, 2 * node + (fcol > thresh[node]), node)
+    sizes = np.bincount(node, minlength=n_nodes).astype(np.float64)
+    node_depth = np.floor(np.log2(np.maximum(
+        np.arange(n_nodes), 1))).astype(np.float64)
+    pv = node_depth + _avg_path_length(sizes)
+    seen = np.unique(node)
+    path_value[seen] = pv[seen]
+    return split_feat, split_thresh, is_leaf, path_value
+
+
+def _gather_subsamples(x, row_sets, opts) -> list:
+    """Streaming sample stage: gather every tree's subsample rows in one
+    bounded sweep over row slabs instead of per-tree fancy indexing. One
+    slab (`chunk_rows`, d) float32 is resident at a time — the residency
+    gauge the oocore binning mapper publishes applies here too."""
+    from ..data.chunk import ChunkSource
+    n, d = x.shape
+    row_bytes = d * 4
+    chunk_rows = int(getattr(opts, "chunk_rows", 0) or 0)
+    if not chunk_rows:
+        budget = int(getattr(opts, "max_resident_bytes", 0) or 0)
+        chunk_rows = max((budget or (32 << 20)) // max(row_bytes, 1), 1)
+    src = ChunkSource(x, chunk_rows=min(chunk_rows, n))
+    out = [np.empty((len(rows), d), np.float32) for rows in row_sets]
+    reliability_metrics.set_gauge(tnames.DATA_OOCORE_RESIDENT_BYTES,
+                                  float(min(chunk_rows, n) * row_bytes))
+    for c in src.chunks:
+        slab = np.asarray(x[c.lo:c.hi], np.float32)
+        for ti, rows in enumerate(row_sets):
+            sel = np.flatnonzero((rows >= c.lo) & (rows < c.hi))
+            if len(sel):
+                out[ti][sel] = slab[rows[sel] - c.lo]
+        reliability_metrics.set_gauge(tnames.DATA_OOCORE_CURSOR, float(c.hi))
+    return out
+
+
+class IsolationForestScorer(IsolationForest):
+    """IsolationForest fit routed through the TrainingSupervisor, producing
+    a model with a compiled serving plan. Same algorithm and Params as the
+    seed estimator, plus the fleet knobs."""
+    checkpoint_dir = Param(
+        "checkpoint_dir",
+        "TrainingSupervisor checkpoint directory; None = plain loop", None)
+    checkpoint_every = Param(
+        "checkpoint_every", "trees per checkpoint write", 8,
+        validator=in_range(0))
+    oocore = Param(
+        "oocore", "data.oocore.OocoreOptions for the streaming sample "
+        "stage (None = resident gather)", None, transient=True)
+    faults = Param(
+        "faults", "reliability.faults.FaultInjector wired into the "
+        "supervisor (chaos drills)", None, transient=True)
+    retry_policy = Param(
+        "retry_policy", "reliability.policy.RetryPolicy bounding step "
+        "restarts (None = supervisor default)", None, transient=True)
+
+    def _fit(self, t: Table) -> "IsolationForestScorerModel":
+        x = np.asarray(t[self.features_col])
+        if x.ndim != 2:
+            raise ValueError(
+                f"IsolationForestScorer features {self.features_col!r} "
+                "must be (n, d)")
+        n, d = x.shape
+        n_trees = self.num_estimators
+        m_sub = min(self.max_samples, n)
+        depth = max(int(np.ceil(np.log2(max(m_sub, 2)))), 1)
+        n_nodes = 1 << (depth + 1)  # heap-indexed, root = 1
+        d_used = max(int(round(self.max_features * d)), 1)
+        seed = int(self.seed or 0)
+
+        # Per-tree seeded streams: draws for tree ti never depend on how
+        # many other trees ran in this process, so checkpoint resume (and
+        # in-process restart replay) regrows exactly the same forest.
+        draw_rngs = [np.random.default_rng([seed, ti, 0])
+                     for ti in range(n_trees)]
+        row_sets = [(r.choice(n, m_sub, replace=True) if self.bootstrap
+                     else r.permutation(n)[:m_sub]) for r in draw_rngs]
+        feat_sets = [r.permutation(d)[:d_used] for r in draw_rngs]
+        subs = (_gather_subsamples(x, row_sets, self.oocore)
+                if self.oocore is not None else None)
+
+        state = {
+            "split_feat": np.zeros((n_trees, n_nodes), np.int32),
+            "split_thresh": np.full((n_trees, n_nodes), np.inf, np.float32),
+            "is_leaf": np.ones((n_trees, n_nodes), bool),
+            "path_value": np.zeros((n_trees, n_nodes), np.float32),
+        }
+
+        def step_fn(ti: int):
+            xt = (subs[ti] if subs is not None
+                  else np.asarray(x[row_sets[ti]], np.float32))
+            sf, st, lf, pv = _grow_tree(
+                xt[:, feat_sets[ti]], np.random.default_rng([seed, ti, 1]),
+                depth, n_nodes, feat_sets[ti])
+            state["split_feat"][ti] = sf
+            state["split_thresh"][ti] = st
+            state["is_leaf"][ti] = lf
+            state["path_value"][ti] = pv
+            reliability_metrics.inc(tnames.WORKLOADS_IFOREST_TREES)
+            return int(n_nodes - lf.sum())  # split count, rides the history
+
+        if self.checkpoint_dir:
+            from ..reliability.supervisor import TrainingSupervisor
+
+            def snapshot() -> dict:
+                return {k: v.copy() for k, v in state.items()}
+
+            def restore(payload: dict) -> None:
+                for k in state:
+                    state[k][...] = np.asarray(payload[k])
+
+            sup = TrainingSupervisor(
+                self.checkpoint_dir, snapshot, restore,
+                checkpoint_every=self.checkpoint_every,
+                handle_signals=False, faults=self.faults,
+                retry_policy=self.retry_policy)
+            try:
+                sup.run(step_fn, n_trees)
+            finally:
+                sup.close()
+        else:
+            for ti in range(n_trees):
+                step_fn(ti)
+
+        m = IsolationForestScorerModel(**{p: getattr(self, p) for p in (
+            "features_col", "score_col", "predicted_label_col")})
+        m._split_feat = state["split_feat"]
+        m._split_thresh = state["split_thresh"]
+        m._is_leaf = state["is_leaf"]
+        m._path_value = state["path_value"]
+        m._c_norm = float(_avg_path_length(np.array([m_sub]))[0])
+        m._depth = depth
+        m._n_features = d
+        plan = m.scoring_plan()
+        if self.contamination > 0:
+            scores = plan(np.asarray(x, np.float32))
+            m._threshold = float(np.quantile(scores, 1 - self.contamination))
+        else:
+            scores = plan(np.asarray(x[:8192], np.float32))
+            m._threshold = 2.0  # scores are < 1; nothing labeled outlier
+        reliability_metrics.set_gauge(tnames.WORKLOADS_IFOREST_THRESHOLD,
+                                      m._threshold)
+        # drift reference: the training score distribution — a shifted
+        # serving score histogram is the anomaly-rate canary
+        attach_workload_observability(self, m, {self.score_col: scores})
+        return m
+
+
+class IsolationForestScorerModel(IsolationForestModel):
+    """Seed model plus the compiled serving surface: a prebuilt host
+    descent (`scoring_plan`) and the `_serving_kernel` protocol that lets
+    `io/plan.py` serve it without Table construction."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._n_features = 0
+
+    def _get_state(self):
+        s = super()._get_state()
+        s["n_features"] = int(self._n_features)
+        return s
+
+    def _set_state(self, s):
+        s = dict(s)
+        self._n_features = int(np.asarray(s.pop("n_features", 0)))
+        super()._set_state(s)
+
+    def scoring_plan(self):
+        """Prebuilt tree-parallel descent in the `Booster.scoring_plan`
+        shape: flatten the (T, n_nodes) heaps once, then each call runs
+        `depth` vectorized gather levels over ONE (n, T) node matrix —
+        `node = 2*node + (x[feat] > thresh)` — and folds the path values
+        to `2^(-mean(h)/c)`. Descent is exact vs the seed device scorer
+        (same float32 comparisons); the mean is accumulated in float32 to
+        match, so parity holds to a few ULPs (pinned rtol 1e-6 in tier-1).
+        A wrong feature width raises ValueError -> per-row 400 upstream."""
+        sf_f = np.ascontiguousarray(self._split_feat, np.int64).ravel()
+        th_f = np.ascontiguousarray(self._split_thresh, np.float32).ravel()
+        leaf_f = np.ascontiguousarray(self._is_leaf, bool).ravel()
+        pv_f = np.ascontiguousarray(self._path_value, np.float32).ravel()
+        n_trees, m = self._split_feat.shape
+        offs = np.arange(n_trees, dtype=np.int64) * m
+        depth = int(self._depth)
+        c_norm = np.float32(self._c_norm)
+        n_features = int(self._n_features)
+
+        def plan(x: np.ndarray) -> np.ndarray:
+            x = np.asarray(x, np.float32)
+            if x.ndim != 2 or (n_features and x.shape[1] != n_features):
+                raise ValueError(
+                    f"expected (n, {n_features}) features, got "
+                    f"{getattr(x, 'shape', None)}")
+            n = x.shape[0]
+            rows = np.arange(n)[:, None]
+            node = np.ones((n, n_trees), np.int64)
+            for _ in range(depth):
+                idx = node + offs
+                stop = leaf_f[idx]
+                xv = x[rows, sf_f[idx]]
+                nxt = 2 * node + (xv > th_f[idx])
+                node = np.where(stop, node, nxt)
+            h = pv_f[node + offs]
+            return np.power(np.float32(2.0),
+                            -h.mean(axis=1, dtype=np.float32)
+                            / c_norm).astype(np.float64)
+
+        return plan
+
+    def _serving_kernel(self, output_col: str):
+        """(n, F) -> values closure for the io/plan.py fast path: outlier
+        scores for `score_col`, the 0/1 contamination label for
+        `predicted_label_col`, None otherwise (generic Table plan)."""
+        if output_col not in (self.score_col, self.predicted_label_col):
+            return None
+        plan = self.scoring_plan()
+        if output_col == self.predicted_label_col:
+            thr = float(self._threshold)
+
+            def kernel(x):
+                return (plan(x) >= thr).astype(np.float64)
+        else:
+            kernel = plan
+        kernel.expected_features = int(self._n_features) or None
+        return kernel
+
+
+# --- graftsem contract ------------------------------------------------------
+from ..analysis.semantic import Case, hot_path_contract  # noqa: E402
+
+
+@hot_path_contract(
+    "iforest.score",
+    expected_executables=1,
+    donate_expected=(),
+    # single-replica gather descent: the whole forest scores with zero
+    # cross-device traffic — any collective appearing here is a regression
+    collective_budget={},
+)
+def iforest_score_contract():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    n_trees, n_nodes, depth, n, d = 4, 16, 3, 16, 5
+    args = (jnp.asarray(rng.normal(size=(n, d)), jnp.float32),
+            jnp.asarray(rng.integers(0, d, (n_trees, n_nodes)), jnp.int32),
+            jnp.asarray(rng.normal(size=(n_trees, n_nodes)), jnp.float32),
+            jnp.asarray(rng.random((n_trees, n_nodes)) < 0.3),
+            jnp.asarray(rng.random((n_trees, n_nodes)), jnp.float32),
+            jnp.float32(1.0))
+    fn = functools.partial(_score_forest, depth=depth)
+    # same shape twice: the second lowering must hit the first executable
+    return [Case("first-batch", fn, args),
+            Case("next-batch", fn, args)]

@@ -1,0 +1,96 @@
+"""Ranking-metric parity against hand-computed fixtures (ISSUE 20).
+
+`recommendation/ranking.py` shipped in the seed with zero direct metric
+coverage. These tests pin NDCG@k / MAP / precision@k / recall@k to
+values computed by hand from the Spark RankingMetrics definitions the
+module documents — including the k-wider-than-predictions case whose
+ideal-DCG length was clipped to the prediction width before this PR
+(inflating NDCG exactly when a recommender under-delivers items).
+"""
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import Table
+from mmlspark_tpu.recommendation.ranking import (RankingEvaluator,
+                                                 ranking_metrics)
+
+
+def _dcg(ranks):
+    """Binary-gain DCG of hits at the given 1-based ranks."""
+    return sum(1.0 / np.log2(r + 1) for r in ranks)
+
+
+# The classic Spark RankingMetrics example: one query, ten predictions,
+# five relevant items hit at ranks 1, 3, 6, 9, 10.
+_PREDS = [[1, 6, 2, 7, 8, 3, 9, 10, 4, 5]]
+_LABELS = [[1, 2, 3, 4, 5]]
+
+
+def test_map_matches_hand_computed_average_precision():
+    m = ranking_metrics(_PREDS, _LABELS, k=10)
+    # precision at each hit rank: 1/1, 2/3, 3/6, 4/9, 5/10; AP = mean/|L|
+    ap = (1 / 1 + 2 / 3 + 3 / 6 + 4 / 9 + 5 / 10) / 5
+    assert m["map"] == pytest.approx(ap, rel=1e-12)
+
+
+def test_ndcg_matches_hand_computed_binary_dcg():
+    m = ranking_metrics(_PREDS, _LABELS, k=10)
+    ideal = _dcg([1, 2, 3, 4, 5])          # 5 labels, all ideally on top
+    assert m["ndcgAt"] == pytest.approx(
+        _dcg([1, 3, 6, 9, 10]) / ideal, rel=1e-12)
+
+
+def test_precision_and_recall_at_k():
+    m = ranking_metrics(_PREDS, _LABELS, k=3)
+    # hits within the top 3: ranks 1 and 3 -> 2 hits
+    assert m["precisionAtk"] == pytest.approx(2 / 3)
+    assert m["recallAtK"] == pytest.approx(2 / 5)
+
+
+def test_precision_divides_by_k_even_when_fewer_predictions():
+    # Spark's precisionAt divides by k regardless of list length
+    m = ranking_metrics([[1, 2]], [[1, 2, 3]], k=5)
+    assert m["precisionAtk"] == pytest.approx(2 / 5)
+
+
+def test_ndcg_ideal_length_uses_k_not_prediction_width():
+    """The pre-PR bug: with 2 predictions, 3 labels and k=3, the ideal
+    DCG must count min(|labels|, k) = 3 slots — clipping it to the
+    prediction width (2) inflated NDCG from 0.469 to 0.613."""
+    m = ranking_metrics([[1, 2]], [[1, 3, 4]], k=3)
+    assert m["ndcgAt"] == pytest.approx(_dcg([1]) / _dcg([1, 2, 3]),
+                                        rel=1e-12)
+
+
+def test_ndcg_multiple_queries_mean():
+    preds = [[1, 6, 2], [0, 9]]
+    labels = [[1, 2], [9]]
+    m = ranking_metrics(preds, labels, k=3)
+    q0 = _dcg([1, 3]) / _dcg([1, 2])
+    q1 = _dcg([2]) / _dcg([1])
+    assert m["ndcgAt"] == pytest.approx((q0 + q1) / 2, rel=1e-12)
+
+
+def test_empty_labels_and_empty_input_are_zero_not_nan():
+    m = ranking_metrics([[1, 2]], [[]], k=2)
+    for name in ("map", "ndcgAt", "precisionAtk", "recallAtK"):
+        assert m[name] == 0.0
+    m = ranking_metrics([], [], k=2)
+    assert m["ndcgAt"] == 0.0 and m["map"] == 0.0
+
+
+def test_duplicate_predictions_count_per_slot():
+    # Spark counts each predicted slot against the label SET: a repeated
+    # relevant id hits twice in DCG but the ideal stays |labels| slots
+    m = ranking_metrics([[1, 1]], [[1]], k=2)
+    assert m["ndcgAt"] == pytest.approx(_dcg([1, 2]) / _dcg([1]), rel=1e-12)
+
+
+def test_ranking_evaluator_selects_metric():
+    t = Table({"prediction": np.asarray(_PREDS), "label": np.asarray(_LABELS)})
+    ev = RankingEvaluator(k=10, metric_name="map")
+    assert ev.evaluate(t) == pytest.approx(
+        ranking_metrics(_PREDS, _LABELS, 10)["map"])
+    full = ev.get_metrics_map(t)
+    assert set(full) == {"map", "ndcgAt", "precisionAtk", "recallAtK",
+                         "diversityAtK"}

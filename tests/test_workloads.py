@@ -1,0 +1,398 @@
+"""Fleet-scale workloads (ISSUE 20): device-native IsolationForest and
+SAR on the full serving/training stack.
+
+Pins the acceptance criteria: compiled iforest descent matches the seed
+scorer (rtol 1e-6); sharded `A @ S` + `lax.top_k` matches the numpy SAR
+top-k (exact index sets) on the 8-virtual-device CPU mesh; both
+workloads serve through `serve_pipeline(fast_path=True)` with
+`plan.recompiles == 0` across repeated same-bucket batches AND across a
+mid-load hot-swap with zero dropped requests; the supervisor-routed
+iforest fit is kill-resume bit-identical; and the seeded chaos drills —
+an injected `serving.swap` fault mid-load rolls back to the incumbent,
+an injected `workloads.sar.refit` fault aborts the candidate fit with
+the incumbent untouched.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import Table
+from mmlspark_tpu.reliability.faults import FaultInjector, InjectedFault
+from mmlspark_tpu.reliability.metrics import reliability_metrics
+from mmlspark_tpu.reliability.policy import RetryPolicy
+from mmlspark_tpu.telemetry import lineage as tlineage
+from mmlspark_tpu.telemetry import names as tnames
+from mmlspark_tpu.telemetry import quality as Q
+from mmlspark_tpu.workloads import (IsolationForestScorer,
+                                    IsolationForestScorerModel, SARServing,
+                                    SARServingModel)
+
+_IFOREST_ARRAYS = ("_split_feat", "_split_thresh", "_is_leaf", "_path_value")
+
+
+@pytest.fixture
+def fleet_state():
+    """Fresh metrics + quality monitor + version registry; restore after."""
+    reliability_metrics.reset()
+    Q.reset_monitor()
+    tlineage.reset_version_registry()
+    tlineage.configure_run_ledger(None)
+    yield
+    tlineage.configure_run_ledger(None)
+    tlineage.reset_version_registry()
+    Q.reset_monitor()
+    reliability_metrics.reset()
+
+
+def _iforest_data(seed=0, n=400, f=6):
+    rng = np.random.default_rng(seed)
+    x = np.vstack([rng.normal(size=(n - n // 20, f)),
+                   rng.normal(4.0, 1.0, size=(n // 20, f))])
+    return Table({"features": x}), x
+
+
+def _iforest_fit(seed=3, **kw):
+    t, x = _iforest_data(seed)
+    est = IsolationForestScorer(num_estimators=24, max_samples=64,
+                                contamination=0.05, seed=seed, **kw)
+    return est.fit(t), t, x
+
+
+def _sar_events(seed=0, n_ev=600, n_users=40, n_items=30):
+    rng = np.random.default_rng(seed)
+    return Table({"user": rng.integers(0, n_users, n_ev),
+                  "item": rng.integers(0, n_items, n_ev),
+                  "rating": rng.integers(1, 6, n_ev).astype(np.float64),
+                  "timestamp": rng.integers(0, 10**6, n_ev).astype(
+                      np.float64)})
+
+
+def _sar_fit(seed=0, k=5, **kw):
+    m = SARServing(support_threshold=2, num_recommendations=k,
+                   **kw).fit(_sar_events(seed))
+    return m
+
+
+def _post(url, payload):
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=15)
+    return resp, json.loads(resp.read())
+
+
+# ------------------------------------------------- iforest scoring parity
+def test_iforest_plan_matches_seed_device_scorer(fleet_state):
+    """Acceptance: the compiled host descent and the seed jit scorer
+    agree to rtol 1e-6 — same float32 comparisons, same heap walk."""
+    m, _, x = _iforest_fit()
+    plan = m.scoring_plan()
+    np.testing.assert_allclose(plan(np.asarray(x, np.float32)),
+                               m._score(np.asarray(x, np.float32)),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_iforest_transform_and_kernels_agree(fleet_state):
+    m, t, x = _iforest_fit()
+    out = m.transform(t)
+    score_k = m._serving_kernel(m.score_col)
+    label_k = m._serving_kernel(m.predicted_label_col)
+    assert score_k.expected_features == x.shape[1]
+    np.testing.assert_allclose(score_k(x), out[m.score_col], rtol=1e-6,
+                               atol=1e-7)
+    np.testing.assert_array_equal(label_k(x).astype(np.int64),
+                                  out[m.predicted_label_col])
+    assert m._serving_kernel("nonexistent") is None
+    with pytest.raises(ValueError):
+        m.scoring_plan()(np.zeros((2, x.shape[1] + 1), np.float32))
+
+
+def test_iforest_fit_attaches_lineage_and_profile(fleet_state):
+    m, _, _ = _iforest_fit()
+    assert m.lineage["estimator"] == "IsolationForestScorer"
+    assert "reference_profile" in m.lineage
+    assert m.quality_profile  # score-distribution drift reference
+    assert reliability_metrics.gauge(
+        tnames.WORKLOADS_IFOREST_THRESHOLD) < 1.0
+    assert reliability_metrics.get(tnames.WORKLOADS_IFOREST_TREES) == 24
+
+
+# ------------------------------------------- iforest supervised training
+def test_iforest_restart_mid_fit_is_bit_identical(fleet_state, tmp_path):
+    clean, t, _ = _iforest_fit()
+    inj = FaultInjector(seed=1337, rules=[
+        {"site": "train.step5", "kind": "crash", "at": [0]}])
+    m = IsolationForestScorer(
+        num_estimators=24, max_samples=64, contamination=0.05, seed=3,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        faults=inj).fit(t)
+    for name in _IFOREST_ARRAYS:
+        np.testing.assert_array_equal(getattr(clean, name),
+                                      getattr(m, name))
+    assert clean._threshold == m._threshold
+    assert reliability_metrics.get("train.step_restarts") >= 1
+
+
+def test_iforest_kill_resume_is_bit_identical(fleet_state, tmp_path):
+    """Acceptance: exhaust restarts (the in-process analogue of a kill),
+    then a FRESH fit on the same checkpoint dir resumes from the
+    per-tree cursor and lands bit-identical to an uninterrupted run."""
+    clean, t, _ = _iforest_fit()
+    kw = dict(num_estimators=24, max_samples=64, contamination=0.05,
+              seed=3, checkpoint_dir=str(tmp_path / "ck"),
+              checkpoint_every=2)
+    inj = FaultInjector(seed=7, rules=[
+        {"site": "train.step9", "kind": "crash", "at": [0, 1]}])
+    with pytest.raises(InjectedFault):
+        IsolationForestScorer(**kw, faults=inj,
+                              retry_policy=RetryPolicy(max_attempts=1)).fit(t)
+    resumed = IsolationForestScorer(**kw).fit(t)
+    for name in _IFOREST_ARRAYS:
+        np.testing.assert_array_equal(getattr(clean, name),
+                                      getattr(resumed, name))
+    assert clean._threshold == resumed._threshold
+    assert reliability_metrics.get("train.resumes") >= 1
+    fp_clean = tlineage.model_version(clean, content=True).content_digest
+    fp_res = tlineage.model_version(resumed, content=True).content_digest
+    assert fp_clean == fp_res
+
+
+def test_iforest_oocore_sample_stage_is_bit_identical(fleet_state):
+    from mmlspark_tpu.data.oocore import OocoreOptions
+    clean, t, _ = _iforest_fit()
+    m = IsolationForestScorer(
+        num_estimators=24, max_samples=64, contamination=0.05, seed=3,
+        oocore=OocoreOptions(chunk_rows=64)).fit(t)
+    for name in _IFOREST_ARRAYS:
+        np.testing.assert_array_equal(getattr(clean, name),
+                                      getattr(m, name))
+    assert reliability_metrics.gauge(
+        tnames.DATA_OOCORE_RESIDENT_BYTES) <= 64 * 6 * 4
+
+
+def test_iforest_estimator_fuzz_roundtrip(fleet_state):
+    from fuzzing import fuzz_estimator
+    t, _ = _iforest_data(1, n=200, f=4)
+    fuzz_estimator(IsolationForestScorer(num_estimators=8, max_samples=32,
+                                         contamination=0.1, seed=2), t)
+
+
+# ------------------------------------------------------ SAR scoring parity
+def test_sar_sharded_topk_matches_numpy_exactly(fleet_state):
+    """Acceptance: the sharded psum matmul + lax.top_k returns exactly
+    the numpy `top_k(A @ S)` index set per user on the 8-device mesh
+    (tie order inside a score level is the documented caveat — random
+    ratings make ties measure-zero here, so sets compare equal)."""
+    m = _sar_fit(k=5)
+    out = m.recommend_plan()(np.arange(m.n_users))
+    scores = (np.asarray(m._affinity, np.float64)
+              @ np.asarray(m._similarity, np.float64))
+    for u in range(m.n_users):
+        want = set(np.argsort(-scores[u], kind="stable")[:5].tolist())
+        assert set(out[u, 0, :].astype(int).tolist()) == want, u
+    # served ratings are the same scores, float32 matmul precision
+    np.testing.assert_allclose(
+        np.sort(out[:, 1, :], axis=1),
+        np.sort(np.partition(-scores, 5, axis=1)[:, :5] * -1, axis=1),
+        rtol=1e-4)
+
+
+def test_sar_remove_seen_and_unknown_users(fleet_state):
+    m = _sar_fit(k=4, remove_seen=True)
+    events = _sar_events(0)
+    users = np.asarray(events["user"])
+    items = np.asarray(events["item"])
+    out = m.recommend_plan()(np.arange(m.n_users))
+    for u in range(m.n_users):
+        seen = set(items[users == u].tolist())
+        assert not (seen & set(out[u, 0, :].astype(int).tolist())), u
+    bad = m.recommend_plan()(np.array([-3, m.n_users + 5]))
+    np.testing.assert_array_equal(bad[:, 0, :], -1.0)
+    assert np.isnan(bad[:, 1, :]).all()
+    assert reliability_metrics.get(tnames.WORKLOADS_SAR_UNKNOWN_USERS) == 2
+
+
+def test_sar_matches_seed_recommend_subset(fleet_state):
+    """The compiled plan and the seed `recommend_for_user_subset` agree
+    on the recommended index sets — the legacy path is the oracle."""
+    m = _sar_fit(k=6)
+    out = m.recommend_plan(num_items=6)(np.arange(m.n_users))
+    seed_tbl = m.recommend_for_user_subset(np.arange(m.n_users), 6)
+    seed_idx = np.asarray(seed_tbl["recommendations"])
+    for u in range(m.n_users):
+        assert (set(out[u, 0, :].astype(int).tolist())
+                == set(seed_idx[u].tolist())), u
+
+
+def test_sar_estimator_fuzz_roundtrip(fleet_state):
+    from fuzzing import fuzz_estimator
+    fuzz_estimator(SARServing(support_threshold=2, num_recommendations=3),
+                   _sar_events(2, n_ev=200, n_users=15, n_items=12))
+
+
+def test_sar_fit_attaches_lineage_profile_and_gauges(fleet_state):
+    m = _sar_fit()
+    assert m.lineage["estimator"] == "SARServing"
+    assert m.quality_profile  # served top-k drift reference
+    assert reliability_metrics.gauge(
+        tnames.WORKLOADS_SAR_CATALOG_ITEMS) == m.n_items
+
+
+# ------------------------------------------------------- serving fast path
+def test_iforest_serves_compiled_with_zero_recompiles(fleet_state):
+    from mmlspark_tpu.io.serving import serve_pipeline
+    m, _, x = _iforest_fit()
+    server, q = serve_pipeline(m, ["features"], output_col="outlierScore")
+    try:
+        want = float(m.scoring_plan()(x[:1].astype(np.float32))[0])
+        for _ in range(6):
+            resp, reply = _post(server.address,
+                                {"features": [float(v) for v in x[0]]})
+        assert reply["outlierScore"] == pytest.approx(want, rel=1e-6)
+        assert resp.headers["X-Model-Version"]
+        stats = q.transform_fn.stats()
+        assert stats["misses"] == 1 and stats["hits"] >= 5
+        assert reliability_metrics.get(tnames.PLAN_RECOMPILES) == 0
+        # malformed width answers a per-row 400, not a 5xx
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.address, {"features": [0.0] * (x.shape[1] + 1)})
+        assert ei.value.code == 400
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_sar_serves_recommend_through_fast_path(fleet_state):
+    from mmlspark_tpu.io.serving import serve_pipeline
+    m = _sar_fit(k=4)
+    server, q = serve_pipeline(m, ["user"], output_col="recommendations")
+    try:
+        want = m.recommend_plan()(np.array([3]))
+        for _ in range(6):
+            resp, reply = _post(server.address, {"user": 3})
+        items, ratings = reply["recommendations"]
+        assert items == [float(v) for v in want[0, 0, :]]
+        np.testing.assert_allclose(ratings, want[0, 1, :], rtol=1e-6)
+        assert resp.headers["X-Model-Version"]
+        stats = q.transform_fn.stats()
+        assert stats["misses"] == 1 and stats["hits"] >= 5
+        assert reliability_metrics.get(tnames.PLAN_RECOMPILES) == 0
+        assert reliability_metrics.get(
+            tnames.WORKLOADS_SAR_RECOMMEND_ROWS) >= 6
+        # a non-integer id is client data -> 400
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.address, {"user": "alice"})
+        assert ei.value.code == 400
+    finally:
+        q.stop()
+        server.stop()
+
+
+# ------------------------------------------- hot-swap + chaos (satellites)
+def test_iforest_hot_swap_mid_load_zero_drops(fleet_state):
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    # distinct seeds AND distinct from other tests' models: the compile
+    # log is process-global, so re-serving an identical model in two
+    # tests would read as a recompile of the same (fingerprint, bucket)
+    model_a, _, x = _iforest_fit(seed=7)
+    model_b, _, _ = _iforest_fit(seed=11)
+    server, q = serve_pipeline(model_a, ["features"],
+                               output_col="outlierScore", mode="microbatch")
+    host, port = server._httpd.server_address[:2]
+    body = json.dumps({"features": [float(v) for v in x[0]]})
+    try:
+        transform = q.transform_fn
+        results = []
+        th = threading.Thread(target=lambda: results.append(
+            run_load(host, port, body, n_clients=8, per_client=30)))
+        th.start()
+        deadline = time.monotonic() + 10.0
+        while (reliability_metrics.get(tnames.SERVING_REQUEST_TOTAL) < 20
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        swap = transform.install_model(model_b)
+        th.join()
+        res = results[0]
+        assert not res.errors, res.errors[:3]
+        assert res.n_ok == 8 * 30 and res.n_dropped == 0
+        assert transform.version == swap["new"] != swap["old"]
+        assert reliability_metrics.get(tnames.PLAN_RECOMPILES) == 0
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_sar_chaos_swap_mid_load_rolls_back_zero_drops(fleet_state):
+    """Satellite: mid-load SAR hot-swap with an injected `serving.swap`
+    fault — the swap raises, the incumbent keeps serving every in-flight
+    and subsequent request (zero drops), and the retry commits."""
+    from mmlspark_tpu.io.loadgen import run_load
+    from mmlspark_tpu.io.serving import serve_pipeline
+    model_a = _sar_fit(seed=2, k=4)   # seeds unique across serving tests:
+    model_b = _sar_fit(seed=5, k=4)   # the compile log is process-global
+    inj = FaultInjector(seed=1337, rules=[
+        {"site": "serving.swap", "kind": "error", "at": [0]}])
+    server, q = serve_pipeline(model_a, ["user"],
+                               output_col="recommendations",
+                               mode="microbatch", faults=inj)
+    host, port = server._httpd.server_address[:2]
+    body = json.dumps({"user": 3})
+    try:
+        transform = q.transform_fn
+        incumbent = transform.version
+        results = []
+        th = threading.Thread(target=lambda: results.append(
+            run_load(host, port, body, n_clients=8, per_client=30)))
+        th.start()
+        deadline = time.monotonic() + 10.0
+        while (reliability_metrics.get(tnames.SERVING_REQUEST_TOTAL) < 20
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        with pytest.raises(InjectedFault):
+            transform.install_model(model_b)
+        assert transform.version == incumbent           # rolled back
+        retry = transform.install_model(model_b)        # schedule spent
+        th.join()
+        res = results[0]
+        assert not res.errors, res.errors[:3]
+        assert res.n_ok == 8 * 30 and res.n_dropped == 0
+        assert transform.version == retry["new"] != incumbent
+        assert reliability_metrics.get(
+            tnames.SERVING_MODEL_SWAP_ERRORS) == 1
+        assert reliability_metrics.get(tnames.SERVING_MODEL_SWAPS) == 1
+        assert reliability_metrics.get(tnames.PLAN_RECOMPILES) == 0
+    finally:
+        q.stop()
+        server.stop()
+
+
+def test_sar_refit_chaos_aborts_candidate_incumbent_untouched(fleet_state):
+    """The new `workloads.sar.refit` site: a fault between the
+    similarity build and model assembly aborts the CANDIDATE fit — the
+    serving incumbent never sees a half-built model because
+    install_model only accepts whole fitted models."""
+    from mmlspark_tpu.io.plan import compile_serving_transform
+    model_a = _sar_fit(seed=0, k=4)
+    transform = compile_serving_transform(model_a, ["user"],
+                                          output_col="recommendations")
+    incumbent = transform.version
+    inj = FaultInjector(seed=11, rules=[
+        {"site": "workloads.sar.refit", "kind": "error", "at": [0]}])
+    with pytest.raises(InjectedFault):
+        SARServing(support_threshold=2, num_recommendations=4,
+                   faults=inj).fit(_sar_events(5))
+    assert transform.version == incumbent
+    out = transform([json.dumps({"user": 3}).encode()])
+    assert out[0].status == 200
+    # the schedule fired once: the refit retry succeeds and swaps in
+    model_b = SARServing(support_threshold=2, num_recommendations=4,
+                         faults=inj).fit(_sar_events(5))
+    swap = transform.install_model(model_b)
+    assert transform.version == swap["new"] != incumbent
